@@ -1,0 +1,44 @@
+"""mxnet_tpu: a TPU-native deep learning framework with MXNet's programming
+model — mixed symbolic/imperative — rebuilt on JAX/XLA/Pallas/pjit.
+
+See SURVEY.md at the repo root for the structural map of the reference
+(lyttonhao/mxnet, v0.9.5) this framework reproduces, TPU-first.
+"""
+from .base import MXNetError, __version__
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_devices
+from . import base
+from . import engine
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from . import autograd
+from . import executor
+from .executor import Executor
+from .symbol import Symbol, Variable, Group, AttrScope
+from .ndarray import NDArray
+
+# subsystems filled in as the build progresses (SURVEY.md section 7 plan)
+from . import initializer
+from . import optimizer
+from . import metric
+from . import lr_scheduler
+from . import io
+from . import kvstore
+from . import kvstore as kv
+from . import callback
+from . import module
+from . import module as mod
+from . import monitor
+from .monitor import Monitor
+from . import model
+from .model import FeedForward
+from . import visualization
+from . import visualization as viz
+from . import rnn
+from . import profiler
+from . import image
+from . import recordio
+from . import test_utils
+from . import parallel

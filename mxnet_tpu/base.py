@@ -1,0 +1,98 @@
+"""Base types and helpers for mxnet_tpu.
+
+TPU-native re-design of the reference's foundation layer
+(ref: include/mxnet/base.h, python/mxnet/base.py). There is no ctypes/C-API
+boundary in the hot path: the substrate is JAX/XLA, so "handles" are plain
+Python objects. A C-API-shaped shim for language bindings lives in
+``mxnet_tpu.c_api`` (built later rounds).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+MXNET_TPU_MAJOR = 0
+MXNET_TPU_MINOR = 1
+MXNET_TPU_PATCH = 0
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu (parity: dmlc error -> MXGetLastError -> Python)."""
+
+
+class NotImplementedForTPU(MXNetError):
+    """A reference feature intentionally absent on the TPU substrate.
+
+    Raised (rather than silently skipped) so users discover documented gaps,
+    e.g. ``dist_async`` parameter-server semantics (SURVEY.md section 5).
+    """
+
+
+_NULL = object()  # sentinel for "unset" attr values (parity: dmlc optional fields)
+
+
+def string_types():
+    return (str,)
+
+
+# ---------------------------------------------------------------------------
+# Attribute coercion: the reference passes all op attrs as strings through the
+# C API and parses them with dmlc::Parameter (ref: include/dmlc parameter
+# usage at src/operator/fully_connected-inl.h:45-55). We accept native Python
+# values AND their string forms so symbol JSON round-trips.
+# ---------------------------------------------------------------------------
+
+def attr_bool(v, default=None):
+    if v is _NULL or v is None:
+        return default
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1"):
+        return True
+    if s in ("false", "0"):
+        return False
+    raise MXNetError("cannot parse bool attr: %r" % (v,))
+
+
+def attr_int(v, default=None):
+    if v is _NULL or v is None:
+        return default
+    if isinstance(v, bool):
+        return int(v)
+    return int(v)
+
+
+def attr_float(v, default=None):
+    if v is _NULL or v is None:
+        return default
+    return float(v)
+
+
+def attr_str(v, default=None):
+    if v is _NULL or v is None:
+        return default
+    return str(v)
+
+
+def attr_tuple(v, default=None, typ=int):
+    """Parse '(2, 2)' / '[2,2]' / (2, 2) / 2 into a tuple."""
+    if v is _NULL or v is None:
+        return default
+    if isinstance(v, (tuple, list)):
+        return tuple(typ(x) for x in v)
+    if isinstance(v, (int, float)):
+        return (typ(v),)
+    s = str(v).strip()
+    if s.startswith(("(", "[")):
+        s = s[1:-1]
+    s = s.strip()
+    if not s:
+        return ()
+    return tuple(typ(float(x)) if typ is int and ("." in x) else typ(x)
+                 for x in (p.strip() for p in s.split(",")) if x)
+
+
+def shape_str(shape):
+    return "(" + ",".join(str(int(x)) for x in shape) + ")"

@@ -1,0 +1,63 @@
+"""Engine facade.
+
+The reference's threaded dependency engine (ref: src/engine/threaded_engine.h,
+threaded_engine_perdevice.cc) schedules every op asynchronously against
+read/write variable dependencies. On the JAX substrate that role collapses
+into XLA's async dispatch: every dispatched computation already runs
+asynchronously with data-flow ordering enforced by jax.Array futures. What
+remains useful — and is kept here — is the *control* surface:
+
+- ``wait_all()``              (ref: Engine::WaitForAll / MXNDArrayWaitAll)
+- ``wait_for_var(arr)``       (ref: Engine::WaitForVar) -> block_until_ready
+- naive/synchronous debug mode (ref: MXNET_ENGINE_TYPE=NaiveEngine) which
+  forces a blocking wait after every imperative op, for bisecting async bugs.
+- ``push(fn)`` for host callbacks ordered after all pending device work.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_naive = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def set_engine_type(name):
+    """'NaiveEngine' => synchronous execution after every imperative op;
+    'ThreadedEngine'/'ThreadedEnginePerDevice' => default async dispatch."""
+    global _naive
+    _naive = (name == "NaiveEngine")
+
+
+def is_naive():
+    return _naive
+
+
+def maybe_sync(arr):
+    """Called after each imperative op; blocks in naive mode."""
+    if _naive and arr is not None:
+        try:
+            arr.block_until_ready()
+        except AttributeError:
+            pass
+    return arr
+
+
+def wait_all():
+    """Block until all pending device computation completes."""
+    jax.effects_barrier()
+    # also sync all live arrays' devices
+    try:
+        jax.block_until_ready(jax.device_put(0))
+    except Exception:
+        pass
+
+
+def wait_for_var(arr):
+    jax.block_until_ready(arr)
+
+
+def push(fn):
+    """Run a host callback after all currently pending work (debug/profiling)."""
+    wait_all()
+    fn()

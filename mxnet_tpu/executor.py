@@ -1,0 +1,413 @@
+"""Executor: binds a Symbol to devices and runs forward/backward.
+
+Re-design of the reference GraphExecutor (ref: src/executor/graph_executor.cc,
+include/mxnet/executor.h, python/mxnet/executor.py). The reference compiles
+the graph itself — Gradient pass, PlaceDevice, InferShape/Type, PlanMemory,
+op bulking (graph_executor.cc:336-759). Here the DAG lowers to one pure JAX
+function and XLA performs all of those roles: ``forward`` is a jitted call,
+``backward`` differentiates the same function with ``jax.vjp`` (no per-op
+backward graph), memory planning/fusion/bulking are XLA's, and gradient
+accumulation honors grad_req write/add/null semantics
+(ref: OpReqType kWriteTo/kAddTo/kNullOp, include/mxnet/op_attr_types.h).
+
+Laziness: ``forward()`` snapshots inputs and defers compute; reading
+``.outputs`` forces a forward-only jit, while calling ``backward()`` first
+runs a single fused forward+backward jit — so a fit() step costs exactly one
+XLA invocation, mirroring the reference's engine overlap for free.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray
+from .ops.registry import OpContext
+from .symbol import Symbol, _topo
+from . import random as _random
+
+
+def _build_graph_runner(symbol):
+    """Lower the symbol DAG to a pure function
+    run(arg_vals: dict, aux_vals: dict, key, is_train) -> (outputs, aux_updates)."""
+    nodes = _topo(symbol._out_nodes())
+
+    def run(arg_vals, aux_vals, key, is_train):
+        env = {}
+        aux_updates = {}
+        for k, node in enumerate(nodes):
+            if node.is_variable:
+                env[(id(node), 0)] = arg_vals[node.name]
+                continue
+            ins = [env[(id(n), i)] for n, i in node.inputs]
+            aux_names = node.op.list_aux(node.attrs)
+            aux_in = [aux_vals["%s_%s" % (node.name, a)] for a in aux_names]
+            rng = None
+            if node.op.needs_rng and key is not None:
+                rng = jax.random.fold_in(key, k)
+            op_ctx = OpContext(is_train=is_train, rng=rng)
+            outs, aux_up = node.op.apply(op_ctx, node.attrs, ins, aux_in)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+            if aux_up is not None:
+                for a, u in zip(aux_names, aux_up):
+                    aux_updates["%s_%s" % (node.name, a)] = u
+        outputs = [env[(id(n), i)] for n, i in symbol._outputs]
+        return outputs, aux_updates
+
+    return run, nodes
+
+
+class _LazyOutputs(object):
+    """Sequence proxy returned by forward(is_train=True): preserves the
+    reference contract (forward returns outputs) without forcing computation
+    unless the caller actually reads it — so fit()'s forward+backward still
+    fuses into one XLA call."""
+
+    __slots__ = ("_exec",)
+
+    def __init__(self, executor):
+        self._exec = executor
+
+    def _force(self):
+        return self._exec.outputs
+
+    def __getitem__(self, i):
+        return self._force()[i]
+
+    def __len__(self):
+        return len(self._exec.output_names)
+
+    def __iter__(self):
+        return iter(self._force())
+
+    def __repr__(self):
+        return "<LazyOutputs of %d outputs>" % len(self)
+
+
+class Executor(object):
+    """Executor over a bound symbol (ref: python/mxnet/executor.py)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._group2ctx = group2ctx or {}
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.arg_dict = self._normalize(args, self.arg_names, "args")
+        self.arg_arrays = [self.arg_dict[n] for n in self.arg_names]
+        if args_grad is None:
+            self.grad_dict = {}
+        else:
+            self.grad_dict = self._normalize(args_grad, self.arg_names,
+                                             "args_grad", allow_missing=True)
+        self.grad_arrays = [self.grad_dict.get(n) for n in self.arg_names]
+        self.aux_dict = self._normalize(aux_states, self.aux_names, "aux",
+                                        allow_missing=False) if self.aux_names else {}
+        self.aux_arrays = [self.aux_dict[n] for n in self.aux_names]
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: (grad_req if n in self.grad_dict else "null")
+                              for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        for n in self.arg_names:
+            if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                raise MXNetError("grad_req %r for %s but no grad array bound"
+                                 % (self._grad_req[n], n))
+
+        self._run, self._nodes = _build_graph_runner(symbol)
+        self._diff_args = [n for n in self.arg_names
+                           if self._grad_req.get(n, "null") != "null"]
+        # group diff args by grad-buffer identity: a buffer shared across
+        # several arguments (weight tying) receives the SUM of their
+        # gradients, written once (ref: DeduplicateVarHandle + kAddTo
+        # semantics, include/mxnet/engine.h:231-249)
+        self._grad_groups = []   # list of (buffer, [arg names])
+        _by_buf = {}
+        for n in self._diff_args:
+            buf = self.grad_dict[n]
+            if id(buf) in _by_buf:
+                self._grad_groups[_by_buf[id(buf)]][1].append(n)
+            else:
+                _by_buf[id(buf)] = len(self._grad_groups)
+                self._grad_groups.append((buf, [n]))
+        self._has_add = any(self._grad_req.get(n) == "add"
+                            for n in self._diff_args)
+        self._needs_rng = any((not n.is_variable) and n.op.needs_rng
+                              for n in self._nodes)
+        self._base_key = _random.split()
+        self._step = 0
+        self._monitor_callback = None
+
+        # pending forward snapshot
+        self._pending = None       # (arg_vals, aux_vals, key, is_train)
+        self._outputs_nd = None
+        self._jit_fwd = {}
+        self._jit_fused = {}
+
+    # ------------------------------------------------------------------
+    def _normalize(self, arrays, names, what, allow_missing=False):
+        if arrays is None:
+            arrays = {}
+        if isinstance(arrays, (list, tuple)):
+            if len(arrays) != len(names):
+                raise MXNetError("%s: expected %d arrays, got %d"
+                                 % (what, len(names), len(arrays)))
+            return {n: a for n, a in zip(names, arrays) if a is not None}
+        out = {}
+        for n in names:
+            if n in arrays:
+                out[n] = arrays[n]
+            elif not allow_missing and what in ("args", "aux"):
+                raise MXNetError("%s: missing array for %r" % (what, n))
+        return out
+
+    # ------------------------------------------------------------------
+    @property
+    def outputs(self):
+        self._ensure_forward()
+        return self._outputs_nd
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._set_data(v.data)
+            else:
+                self.arg_dict[k]._set_data(jnp.asarray(np.asarray(v)))
+        key = None
+        if self._needs_rng:
+            key = jax.random.fold_in(self._base_key, self._step)
+            self._step += 1
+        arg_vals = {n: self.arg_dict[n].data for n in self.arg_names}
+        aux_vals = {n: self.aux_dict[n].data for n in self.aux_names}
+        self._pending = (arg_vals, aux_vals, key, bool(is_train))
+        self._outputs_nd = None
+        if self._monitor_callback is not None:
+            self._ensure_forward()
+            return self._outputs_nd
+        if not is_train:
+            # eval path: force now (async dispatch, does not block)
+            self._ensure_forward()
+            return self._outputs_nd
+        # training path stays lazy so backward() fuses fwd+bwd into one jit;
+        # the proxy forces computation only if the caller actually reads it
+        return _LazyOutputs(self)
+
+    def _ensure_forward(self):
+        if self._outputs_nd is not None:
+            return
+        if self._pending is None:
+            raise MXNetError("call forward() first")
+        arg_vals, aux_vals, key, is_train = self._pending
+        if self._monitor_callback is not None:
+            self._forward_monitored(arg_vals, aux_vals, key, is_train)
+            return
+        if is_train not in self._jit_fwd:
+            run = self._run
+
+            def fwd(arg_vals, aux_vals, key):
+                return run(arg_vals, aux_vals, key, is_train)
+
+            self._jit_fwd[is_train] = jax.jit(fwd)
+        outs, aux_up = self._jit_fwd[is_train](arg_vals, aux_vals, key)
+        self._finish(outs, aux_up, is_train)
+
+    def _finish(self, outs, aux_up, is_train):
+        self._outputs_nd = [NDArray(o) for o in outs]
+        if is_train:
+            for n, u in aux_up.items():
+                self.aux_dict[n]._set_data(u)
+
+    def _forward_monitored(self, arg_vals, aux_vals, key, is_train):
+        """Un-jitted per-node execution invoking the monitor callback on every
+        op output (ref: GraphExecutor::SetMonitorCallback,
+        graph_executor.cc:63-70,:761-781)."""
+        env = {}
+        aux_updates = {}
+        for k, node in enumerate(self._nodes):
+            if node.is_variable:
+                env[(id(node), 0)] = arg_vals[node.name]
+                continue
+            ins = [env[(id(n), i)] for n, i in node.inputs]
+            aux_names = node.op.list_aux(node.attrs)
+            aux_in = [aux_vals["%s_%s" % (node.name, a)] for a in aux_names]
+            rng = (jax.random.fold_in(key, k)
+                   if node.op.needs_rng and key is not None else None)
+            outs, aux_up = node.op.apply(OpContext(is_train, rng),
+                                         node.attrs, ins, aux_in)
+            for i, (oname, o) in enumerate(zip(node.output_names(), outs)):
+                env[(id(node), i)] = o
+                self._monitor_callback(oname, NDArray(o))
+            if aux_up is not None:
+                for a, u in zip(aux_names, aux_up):
+                    aux_updates["%s_%s" % (node.name, a)] = u
+        outs = [env[(id(n), i)] for n, i in self._symbol._outputs]
+        self._finish(outs, aux_updates, is_train)
+
+    # ------------------------------------------------------------------
+    def backward(self, out_grads=None):
+        """Run backward; fills bound gradient arrays honoring grad_req.
+
+        If outputs were not yet forced, runs ONE fused forward+backward jit.
+        """
+        if self._pending is None:
+            raise MXNetError("call forward(is_train=True) before backward()")
+        arg_vals, aux_vals, key, is_train = self._pending
+        if not is_train:
+            raise MXNetError("backward called on forward(is_train=False)")
+        if not self._diff_args:
+            self._ensure_forward()
+            return
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        use_default_head = out_grads is None
+        jkey = (use_default_head,)
+        if jkey not in self._jit_fused:
+            self._jit_fused[jkey] = self._make_fused(use_default_head)
+        head_vals = ([] if use_default_head
+                     else [g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                           for g in out_grads])
+        prev_grads = ([buf.data for buf, _names in self._grad_groups]
+                      if self._has_add else [])
+        outs, grads, aux_up = self._jit_fused[jkey](
+            arg_vals, aux_vals, key, head_vals, prev_grads)
+        self._finish(outs, aux_up, is_train=True)
+        for (buf, _names), g in zip(self._grad_groups, grads):
+            buf._set_data(g)
+
+    def _make_fused(self, use_default_head):
+        run = self._run
+        diff_args = list(self._diff_args)
+        grad_req = dict(self._grad_req)
+        groups = [tuple(names) for _buf, names in self._grad_groups]
+
+        def fused(arg_vals, aux_vals, key, head_vals, prev_grads):
+            def f(diff_vals):
+                full = dict(arg_vals)
+                for n, v in zip(diff_args, diff_vals):
+                    full[n] = v
+                outs, aux_up = run(full, aux_vals, key, True)
+                return outs, aux_up
+
+            primal_in = [arg_vals[n] for n in diff_args]
+            (outs, aux_up), vjp_fn = jax.vjp(f, primal_in, has_aux=False)
+            # vjp over the (outs, aux_up) pair: zero-cotangent the aux part
+            cots_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+            if use_default_head:
+                cots = [jnp.ones_like(o) for o in outs]
+            else:
+                cots = list(head_vals)
+            (dgrads,) = vjp_fn((cots, cots_aux))
+            by_name = dict(zip(diff_args, dgrads))
+            final = []
+            for gi, names in enumerate(groups):
+                g = by_name[names[0]]
+                for n in names[1:]:
+                    g = g + by_name[n]
+                if grad_req[names[0]] == "add":
+                    g = prev_grads[gi] + g
+                final.append(g)
+            return outs, final, aux_up
+
+        donate = (4,) if self._has_add else ()
+        return jax.jit(fused, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    array.data if isinstance(array, NDArray)
+                    else jnp.asarray(np.asarray(array)))
+            elif not allow_extra_params:
+                raise MXNetError("copy_params_from: %r not an argument" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(
+                        array.data if isinstance(array, NDArray)
+                        else jnp.asarray(np.asarray(array)))
+                elif not allow_extra_params:
+                    raise MXNetError("copy_params_from: %r not aux" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound to new input shapes, sharing parameter
+        arrays whose shapes are unchanged (ref: executor.py reshape — the
+        bucketing re-bind path; jit caching makes this cheap)."""
+        new_shapes = {}
+        for n in self.arg_names:
+            if n in kwargs:
+                new_shapes[n] = tuple(kwargs[n])
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**new_shapes)
+        args = {}
+        grads = {}
+        for n, sh in zip(self.arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if sh is None or tuple(cur.shape) == tuple(sh):
+                args[n] = cur
+                if n in self.grad_dict:
+                    grads[n] = self.grad_dict[n]
+            else:
+                args[n] = NDArray(jnp.zeros(sh, cur.data.dtype))
+                if n in self.grad_dict:
+                    grads[n] = NDArray(jnp.zeros(sh, cur.data.dtype))
+        aux = {}
+        for n, sh in zip(self.aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            aux[n] = (cur if sh is None or tuple(cur.shape) == tuple(sh)
+                      else NDArray(jnp.zeros(sh, cur.data.dtype)))
+        return Executor(self._symbol, self._ctx, args, grads or None,
+                        self._grad_req, aux, group2ctx=self._group2ctx)
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % ", ".join(self.output_names)]
+        for node in self._nodes:
+            if node.is_variable:
+                lines.append("Variable:%s" % node.name)
+            else:
+                lines.append("Op:%s, Name=%s" % (node.op.name, node.name))
+        return "\n".join(lines)
+
+
+def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                shared_exec=None, **kwargs):
+    """Allocate all arrays from inferred shapes then bind
+    (ref: python/mxnet/symbol.py:1114 simple_bind)."""
+    arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+    if arg_shapes is None:
+        raise MXNetError("simple_bind: cannot infer shapes from %r" % kwargs)
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    type_dict = type_dict or {}
+    args = {}
+    grads = {}
+    for n, sh in zip(arg_names, arg_shapes):
+        dt = np.dtype(type_dict.get(n, np.float32))
+        args[n] = NDArray(jnp.zeros(sh, dt))
+        req = grad_req if isinstance(grad_req, str) else (
+            grad_req[arg_names.index(n)] if isinstance(grad_req, (list, tuple))
+            else grad_req.get(n, "null"))
+        if req != "null":
+            grads[n] = NDArray(jnp.zeros(sh, dt))
+    aux = {n: NDArray(jnp.zeros(sh, np.dtype(np.float32)))
+           for n, sh in zip(aux_names, aux_shapes)}
+    return Executor(symbol, ctx, args, grads or None, grad_req, aux,
+                    group2ctx=group2ctx, shared_exec=shared_exec)

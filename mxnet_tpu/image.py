@@ -1,0 +1,220 @@
+"""Python image pipeline (ref: python/mxnet/image.py, 559 LoC — ImageIter +
+augmenters over imdecode; C++ stack at src/io/iter_image_recordio*.cc).
+
+Decode uses Pillow (OpenCV is absent from the TPU image); augmenters are
+numpy-based host-side transforms. The ImageRecordIter-style high-throughput
+path (threaded decode, RecordIO shards, part_index/num_parts sharding) is in
+ImageIter below over mxnet_tpu.recordio.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from . import io as mxio
+from . import random as _random
+from . import recordio
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to HWC ndarray (ref: mx.image.imdecode)."""
+    try:
+        from PIL import Image
+    except ImportError:
+        raise MXNetError("imdecode requires Pillow")
+    img = Image.open(_io.BytesIO(buf))
+    if flag == 0:
+        img = img.convert("L")
+    else:
+        img = img.convert("RGB" if to_rgb else "RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if flag == 1 and not to_rgb:
+        arr = arr[:, :, ::-1]  # BGR like the OpenCV path
+    res = array(arr.astype(np.uint8))
+    if out is not None:
+        out._set_data(res.data)
+        return out
+    return res
+
+
+def _resize(img, w, h):
+    from PIL import Image
+    return np.asarray(Image.fromarray(img.astype(np.uint8)).resize(
+        (w, h), Image.BILINEAR))
+
+
+def resize_short(img, size):
+    """Resize shorter edge to size (ref: image.py resize_short)."""
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return _resize(img, new_w, new_h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize(out, size[0], size[1])
+    return out
+
+
+def random_crop(src, size):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    rng = _random.np_rng()
+    x0 = int(rng.integers(0, w - new_w + 1))
+    y0 = int(rng.integers(0, h - new_h + 1))
+    out = fixed_crop(src, x0, y0, new_w, new_h, size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(np.float32) - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+# -- augmenter factories (ref: image.py CreateAugmenter) --------------------
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, **kwargs):
+    auglist = []
+    size = (data_shape[2], data_shape[1])
+    if resize > 0:
+        auglist.append(lambda img: resize_short(img, resize))
+    if rand_crop:
+        auglist.append(lambda img: random_crop(img, size)[0])
+    else:
+        auglist.append(lambda img: center_crop(img, size)[0])
+    if rand_mirror:
+        def mirror(img):
+            if _random.np_rng().random() < 0.5:
+                return img[:, ::-1]
+            return img
+        auglist.append(mirror)
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(lambda img: color_normalize(img.astype(np.float32),
+                                                   mean, std))
+    return auglist
+
+
+class ImageIter(mxio.DataIter):
+    """Image iterator over RecordIO or an image list
+    (ref: image.py ImageIter; C++ ImageRecordIter at
+    src/io/iter_image_recordio_2.cc). Supports part_index/num_parts sharding
+    for data-parallel hosts."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.path_root = path_root
+        self.record = None
+        self.imglist = None
+        if path_imgrec is not None:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.record = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self.record.keys)
+        elif path_imglist is not None:
+            self.imglist = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:-1], dtype=np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = list(self.imglist.keys())
+        elif imglist is not None:
+            self.imglist = {}
+            for i, rec in enumerate(imglist):
+                self.imglist[i] = (np.array(rec[0], dtype=np.float32)
+                                   if not np.isscalar(rec[0])
+                                   else np.array([rec[0]], dtype=np.float32),
+                                   rec[1])
+            self.seq = list(self.imglist.keys())
+        else:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist or imglist")
+        # host-level sharding (ref: part_index/num_parts)
+        if num_parts > 1:
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        self.shuffle = shuffle
+        self.aug_list = aug_list if aug_list is not None else []
+        self.data_name = data_name
+        self.label_name = label_name
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [mxio.DataDesc(self.data_name,
+                              (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [mxio.DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle:
+            _random.np_rng().shuffle(self.seq)
+        self.cur = 0
+
+    def _read_one(self, key):
+        if self.record is not None:
+            s = self.record.read_idx(key)
+            header, img_bytes = recordio.unpack(s)
+            label = header.label
+            img = imdecode(img_bytes).asnumpy()
+        else:
+            label, fname = self.imglist[key]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                img = imdecode(f.read()).asnumpy()
+        for aug in self.aug_list:
+            img = aug(img)
+        # HWC -> CHW
+        img = np.transpose(img.astype(np.float32), (2, 0, 1))
+        return img, label
+
+    def next(self):
+        if self.cur + self.batch_size > len(self.seq):
+            raise StopIteration
+        data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
+        labels = np.zeros((self.batch_size, self.label_width), np.float32)
+        for i in range(self.batch_size):
+            img, label = self._read_one(self.seq[self.cur + i])
+            data[i] = img
+            labels[i] = np.asarray(label, np.float32).reshape(-1)[:self.label_width]
+        self.cur += self.batch_size
+        label_arr = labels[:, 0] if self.label_width == 1 else labels
+        return mxio.DataBatch(data=[array(data)], label=[array(label_arr)],
+                              pad=0, index=None)

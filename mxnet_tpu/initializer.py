@@ -1,0 +1,276 @@
+"""Weight initializers (ref: python/mxnet/initializer.py, 659 LoC).
+
+Same dispatch contract as the reference: an Initializer is called with an
+``InitDesc`` (name + symbol attrs) and routes by name suffix — *_weight to the
+method, *_bias/beta/moving_mean to zero, gamma/moving_var to one — with
+``__init__`` attrs overriding (ref: initializer.py InitDesc attr-aware
+dispatch). Random draws use the functional PRNG stream (mxnet_tpu.random).
+"""
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+
+from .base import MXNetError
+from . import random as _random
+from .ndarray import NDArray
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs descriptor (ref: initializer.py InitDesc)."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer(object):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            _INIT_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("moving_inv_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- leaf rules -----------------------------------------------------
+    def _set(self, arr, value):
+        arr[:] = value
+
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError()
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+
+@register
+class Zero(Initializer):
+    """Explicit constant choice overrides suffix dispatch."""
+    def __call__(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def __call__(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def __call__(self, desc, arr):
+        arr[:] = self.value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        rng = _random.np_rng()
+        arr[:] = rng.uniform(-self.scale, self.scale, arr.shape).astype(np.float32)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        rng = _random.np_rng()
+        arr[:] = rng.normal(0, self.sigma, arr.shape).astype(np.float32)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        rng = _random.np_rng()
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rng.normal(0.0, 1.0, (nout, nin))
+        u, _s, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape).astype(np.float32)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, _, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) > 2:
+            hw_scale = float(np.prod(shape[2:]))
+        fan_in = shape[1] * hw_scale if len(shape) > 1 else shape[0]
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("Xavier: bad factor_type %r" % self.factor_type)
+        scale = math.sqrt(self.magnitude / factor)
+        rng = _random.np_rng()
+        if self.rnd_type == "uniform":
+            arr[:] = rng.uniform(-scale, scale, shape).astype(np.float32)
+        elif self.rnd_type == "gaussian":
+            arr[:] = rng.normal(0, scale, shape).astype(np.float32)
+        else:
+            raise MXNetError("Xavier: bad rnd_type %r" % self.rnd_type)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (ref: initializer.py Bilinear)."""
+    def _init_weight(self, _, arr):
+        weight = np.zeros(arr.shape, dtype=np.float32).reshape(-1)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, other gates 0
+    (ref: initializer.py LSTMBias; gate order i,f,c,o)."""
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+class Load(object):
+    """Initialize from a dict of arrays, falling back to default_init."""
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {
+            (k[4:] if k.startswith("arg:") or k.startswith("aux:") else k): v
+            for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError("Load: shape mismatch for %s" % name)
+            arr[:] = src.asnumpy() if isinstance(src, NDArray) else src
+        else:
+            if self.default_init is None:
+                raise MXNetError("Load: no init for %r" % name)
+            self.default_init(name, arr)
+
+
+class Mixed(object):
+    """Regex-pattern dispatch over initializers (ref: initializer.py Mixed)."""
+    def __init__(self, patterns, initializers):
+        import re
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError("Mixed: no matching pattern for %r" % str(name))

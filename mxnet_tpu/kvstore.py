@@ -1,0 +1,192 @@
+"""KVStore: key-value store for data-parallel gradient aggregation.
+
+Re-design of the reference KVStore stack (ref: include/mxnet/kvstore.h,
+src/kvstore/kvstore_local.h, comm.h, kvstore_dist.h — SURVEY.md section 2.4).
+The single-process semantics are identical: ``push`` groups values by key,
+reduces (sums) across the device list, applies the updater (or accumulates),
+``pull`` broadcasts the stored value to each output. What changes is the
+substrate:
+
+- 'local'/'device': the reference hand-rolls copy+sum across GPUs
+  (CommCPU/CommDevice, comm.h:62-373). Here values live as jax.Arrays; the
+  reduce is one fused XLA sum — and in the Module fast path gradients never
+  pass through host memory at all.
+- 'dist_sync'/'dist_device_sync': the reference's ps-lite parameter server
+  (ZMQ push/pull to sharded servers) is replaced by SPMD collectives —
+  ``jax.lax.psum`` over the ICI/DCN mesh inside the pjit-ed train step (see
+  mxnet_tpu.parallel). This KVStore front-end keeps rank/num_workers/barrier
+  semantics over ``jax.distributed`` for the host-side control plane.
+- 'dist_async': intentionally NOT supported — fully-async parameter-server
+  updates have no idiomatic TPU/SPMD analog (documented gap, SURVEY §5);
+  a clear error explains the substitute.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError, NotImplementedForTPU
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+
+class KVStore(object):
+    """Single-process KVStore (types 'local', 'device')."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("init: key %r already initialized" % (k,))
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("push: key %r not initialized" % (k,))
+            # reduce across the device list (ref: comm_->Reduce,
+            # kvstore_local.h:95-113) — one fused XLA sum
+            merged = vlist[0].data
+            for v in vlist[1:]:
+                merged = merged + v.data
+            merged_nd = NDArray(merged)
+            if self._updater is not None:
+                self._updater(k, merged_nd, self._store[k])
+            else:
+                # no updater: stored <- merged (ref: kvstore_local.h Push
+                # CopyFromTo path — push replaces with the reduced value)
+                self._store[k]._set_data(merged)
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("pull: key %r not initialized" % (k,))
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Use this optimizer as the updater (serialized round-trip kept for
+        parity with the controller-command path, kvstore.py:226)."""
+        optim_str = pickle.dumps(optimizer)
+        self._set_updater(opt.get_updater(pickle.loads(optim_str)))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier(self):
+        pass
+
+    barrier = _barrier
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def num_dead_node(self, node_id, timeout_sec=60):
+        """ref: kvstore_dist.h:159-168 — dead-node count surfaced to user
+        scripts; on the jax.distributed control plane failures surface as
+        exceptions, so a healthy store reports 0."""
+        return 0
+
+
+class KVStoreDistSync(KVStore):
+    """BSP data-parallel store over the jax.distributed control plane.
+
+    Within one process this behaves exactly like 'local'; across processes
+    (multi-host pods) gradient aggregation itself rides the in-step psum
+    (mxnet_tpu.parallel.grad_sync) — this object supplies rank/size/barrier
+    (ref semantics: kvstore_dist.h sync mode, kvstore_dist_server.h:164-198).
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        self._rank, self._size = _dist_rank_size()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def _barrier(self):
+        if self._size > 1:
+            import jax
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+    barrier = _barrier
+
+
+def _dist_rank_size():
+    import jax
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def _key_value(key, value):
+    """Normalize to (keys, list-of-value-lists) (ref: kvstore.py _ctype_key_value)."""
+    if isinstance(key, (int, str)):
+        keys = [key]
+        values = [value if isinstance(value, (list, tuple)) else [value]]
+        return keys, values
+    assert len(key) == len(value)
+    values = []
+    for v in value:
+        values.append(v if isinstance(v, (list, tuple)) else [v])
+    return list(key), values
+
+
+def create(name="local"):
+    """Create a KVStore (ref: src/kvstore/kvstore.cc:17-45 factory).
+
+    'local'/'device' — single-process multi-device (device-side reduce is
+    automatic on the XLA substrate, so both names share one impl).
+    'dist_sync'/'dist_device_sync' — BSP over jax.distributed + in-step psum.
+    'dist_async' — unsupported on TPU (see module docstring).
+    """
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if "async" in name:
+        raise NotImplementedForTPU(
+            "dist_async parameter-server semantics have no TPU/SPMD analog; "
+            "use dist_sync (BSP via psum over ICI). See SURVEY.md section 5.")
+    if "dist" in name:
+        return KVStoreDistSync(name)
+    if name in ("local", "device", "local_allreduce_cpu",
+                "local_allreduce_device"):
+        return KVStore(name)
+    raise MXNetError("unknown kvstore type %r" % name)

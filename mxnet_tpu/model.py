@@ -1,0 +1,245 @@
+"""Model-level helpers and the legacy FeedForward estimator
+(ref: python/mxnet/model.py, 946 LoC — kvstore helpers :40-117,
+checkpointing, FeedForward :387).
+"""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol as sym
+from . import kvstore as kvs
+from . import io
+from .context import cpu, current_context
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Create kvstore per the reference decision table (ref: model.py:40-77)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            # a single device: no need for kvstore at all
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape)
+                               for param in arg_params.values())
+                if max_size < 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """ref: model.py:79-87 _initialize_kvstore."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """ref: model.py:88-97 — push grad, pull back updated weight; priority
+    -index preserved for parity (ordering is XLA's concern here)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg, grad = pair
+        if grad is None:
+            continue
+        kvstore.push(index, grad, priority=-index)
+        kvstore.pull(index, arg, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None):
+    """ref: model.py:99-117 — aggregate on kvstore, update locally."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg, grad = pair
+        if grad is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad, priority=-index)
+            kvstore.pull(index, grad, priority=-index)
+        updater(index, grad, arg)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save symbol JSON + params (ref: model.py save_checkpoint)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) (ref: model.py load_checkpoint)."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+def _init_iter(X, y, batch_size, is_train=True):
+    if isinstance(X, io.DataIter):
+        return X
+    if isinstance(X, NDArray):
+        X = X.asnumpy()
+    X = np.asarray(X)
+    if y is not None:
+        if isinstance(y, NDArray):
+            y = y.asnumpy()
+        y = np.asarray(y)
+    if is_train:
+        return io.NDArrayIter(X, y, min(X.shape[0], batch_size),
+                              shuffle=is_train, last_batch_handle="roll_over")
+    return io.NDArrayIter(X, y, min(X.shape[0], batch_size), shuffle=False)
+
+
+class FeedForward(object):
+    """Legacy estimator API (ref: model.py:387 FeedForward). Thin shell over
+    Module — deprecated in the reference too, kept for script parity."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx if ctx is not None else [current_context()]
+        if not isinstance(self.ctx, list):
+            self.ctx = [self.ctx]
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None else Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs.copy()
+        self._module = None
+
+    def _label_names(self):
+        args = set(self.symbol.list_arguments())
+        for cand in ("softmax_label", "label", "lro_label"):
+            if cand in args:
+                return [cand]
+        labels = [a for a in self.symbol.list_arguments()
+                  if a.endswith("_label") or a == "label"]
+        return labels or ["softmax_label"]
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module.module import Module
+        data = _init_iter(X, y, self.numpy_batch_size, is_train=True)
+        if eval_data is not None and not isinstance(eval_data, io.DataIter):
+            ex, ey = eval_data
+            eval_data = _init_iter(ex, ey, self.numpy_batch_size, is_train=False)
+        if self.epoch_size is not None:
+            data = io.ResizeIter(data, self.epoch_size)
+        label_names = [d.name for d in (data.provide_label or [])] \
+            or self._label_names()
+        self._module = Module(self.symbol,
+                              data_names=[d.name for d in data.provide_data],
+                              label_names=label_names,
+                              context=self.ctx, logger=logger or logging)
+        opt_params = dict(self.kwargs)
+        self._module.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, optimizer=self.optimizer,
+                         optimizer_params=opt_params,
+                         eval_end_callback=eval_end_callback,
+                         eval_batch_end_callback=eval_batch_end_callback,
+                         initializer=self.initializer,
+                         arg_params=self.arg_params,
+                         aux_params=self.aux_params,
+                         begin_epoch=self.begin_epoch,
+                         num_epoch=self.num_epoch, monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        from .module.module import Module
+        data = _init_iter(X, None, self.numpy_batch_size, is_train=False)
+        if self._module is None or not self._module.binded:
+            self._module = Module(self.symbol,
+                                  data_names=[d.name for d in data.provide_data],
+                                  label_names=None, context=self.ctx)
+            self._module.bind(data_shapes=data.provide_data,
+                              label_shapes=None, for_training=False)
+            self._module.set_params(self.arg_params, self.aux_params or {})
+        out = self._module.predict(data, num_batch=num_batch, reset=reset)
+        if isinstance(out, list):
+            return [o.asnumpy() for o in out]
+        return out.asnumpy()
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        data = _init_iter(X, y, self.numpy_batch_size, is_train=False)
+        assert self._module is not None
+        res = self._module.score(data, eval_metric, num_batch=num_batch,
+                                 batch_end_callback=batch_end_callback,
+                                 reset=reset)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer
+                            if initializer is not None else None, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

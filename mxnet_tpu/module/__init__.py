@@ -1,0 +1,5 @@
+"""Module API (ref: python/mxnet/module/ — the training API contract,
+SURVEY.md §2.6)."""
+from .base_module import BaseModule, BatchEndParam
+from .module import Module
+from .executor_group import DataParallelExecutorGroup

@@ -1,0 +1,155 @@
+"""DataParallelExecutorGroup (ref: python/mxnet/module/executor_group.py).
+
+The reference creates one executor per device, scatters batch slices
+(`decide_slices`, executor_group.py:207-231; `_load_general` :14-41) and
+gathers outputs (`_merge_multi_context` :53). On the SPMD substrate the same
+data parallelism is ONE executor whose jit runs over a ``jax.sharding.Mesh``
+of the given contexts: inputs are device_put with the batch axis sharded
+('data' mesh axis), parameters replicated, and XLA/GSPMD inserts the gradient
+all-reduce (psum over ICI) that the reference implemented as CommDevice
+copy+sum (comm.h:211-373). The class keeps the reference's API so Module and
+user code are unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+from ..executor import simple_bind
+
+
+def _create_mesh(contexts):
+    devices = [c.to_device() for c in contexts]
+    if len(set(devices)) != len(devices):
+        # duplicate physical devices (cpu(0), cpu(1) on one host): no mesh
+        return None
+    return jax.sharding.Mesh(np.array(devices), ("data",))
+
+
+class DataParallelExecutorGroup(object):
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = [c if isinstance(c, Context) else Context(c)
+                         for c in contexts]
+        self.workload = workload
+        self.param_names = list(param_names)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = list(state_names or [])
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.data_names = [x.name if hasattr(x, "name") else x[0]
+                           for x in data_shapes]
+        self.label_names = [x.name if hasattr(x, "name") else x[0]
+                            for x in (label_shapes or [])]
+        self.batch_size = (data_shapes[0].shape if hasattr(data_shapes[0], "shape")
+                           else data_shapes[0][1])[0]
+
+        self._mesh = (_create_mesh(self.contexts)
+                      if len(self.contexts) > 1 else None)
+        self._data_sharding = None
+        self._repl_sharding = None
+        if self._mesh is not None:
+            P = jax.sharding.PartitionSpec
+            self._data_sharding = jax.sharding.NamedSharding(self._mesh, P("data"))
+            self._repl_sharding = jax.sharding.NamedSharding(self._mesh, P())
+
+        # grad_req per arg (ref: executor_group.py grad_req dict build)
+        if self.for_training:
+            req = {}
+            for name in self.arg_names:
+                if name in self.param_names:
+                    req[name] = ("null" if name in self.fixed_param_names
+                                 else (grad_req if isinstance(grad_req, str)
+                                       else grad_req.get(name, "write")))
+                elif name in self.data_names:
+                    req[name] = "write" if inputs_need_grad else "null"
+                else:
+                    req[name] = "null"
+            self.grad_req = req
+        else:
+            self.grad_req = {name: "null" for name in self.arg_names}
+
+        shapes = {}
+        for d in data_shapes:
+            name, shape = (d.name, d.shape) if hasattr(d, "name") else (d[0], d[1])
+            shapes[name] = shape
+        for l in (label_shapes or []):
+            name, shape = (l.name, l.shape) if hasattr(l, "name") else (l[0], l[1])
+            shapes[name] = shape
+
+        ctx0 = self.contexts[0]
+        shared = shared_group.executor if shared_group is not None else None
+        self.executor = simple_bind(symbol, ctx0, grad_req=self.grad_req,
+                                    shared_exec=shared, **shapes)
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self._replicate_params()
+
+    # ------------------------------------------------------------------
+    def _replicate_params(self):
+        if self._mesh is None:
+            return
+        for n in self.param_names:
+            arr = self.executor.arg_dict[n]
+            arr._set_data(jax.device_put(arr.data, self._repl_sharding))
+        for n in self.aux_names:
+            arr = self.executor.aux_dict[n]
+            arr._set_data(jax.device_put(arr.data, self._repl_sharding))
+
+    def _shard_batch(self, value):
+        v = value.data if isinstance(value, NDArray) else jnp.asarray(np.asarray(value))
+        if self._mesh is not None:
+            v = jax.device_put(v, self._data_sharding)
+        return v
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, value in zip(self.data_names, data_batch.data):
+            feed[name] = NDArray(self._shard_batch(value))
+        if self.label_names and data_batch.label:
+            for name, value in zip(self.label_names, data_batch.label):
+                feed[name] = NDArray(self._shard_batch(value))
+        self.executor.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        self.executor.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self.executor.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True to get input grads")
+        return [self.executor.grad_dict[n] for n in self.data_names]
+
+    def get_grads(self):
+        return [self.executor.grad_dict[n] for n in self.param_names
+                if n in self.executor.grad_dict]
+
+    # ------------------------------------------------------------------
+    def set_params(self, arg_params, aux_params):
+        self.executor.copy_params_from(arg_params, aux_params,
+                                       allow_extra_params=True)
+        self._replicate_params()
+
+    def get_params(self, arg_params, aux_params):
+        """Copy current params into the given dicts (host-side)."""
+        for name in self.param_names:
+            arg_params[name] = self.executor.arg_dict[name].copy()
+        for name in self.aux_names:
+            aux_params[name] = self.executor.aux_dict[name].copy()
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())

@@ -1,0 +1,14 @@
+"""Operator library: single registry serving both imperative (ndarray) and
+symbolic (symbol) namespaces (SURVEY.md section 2.3 inventory)."""
+from . import registry
+from . import tensor        # noqa: F401  (registers tensor ops)
+from . import nn            # noqa: F401  (registers nn layer ops)
+from . import optimizer_op  # noqa: F401  (registers fused update ops)
+
+get = registry.get
+exists = registry.exists
+list_ops = registry.list_ops
+OpContext = registry.OpContext
+OpDef = registry.OpDef
+register = registry.register
+register_def = registry.register_def

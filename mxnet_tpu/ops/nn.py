@@ -1,0 +1,794 @@
+"""Neural-network layer operators.
+
+TPU-native re-implementations of the reference layer ops
+(ref: src/operator/*-inl.h — SURVEY.md section 2.3). Kernels are XLA
+emissions (lax.conv_general_dilated for conv, lax.reduce_window for pooling)
+in NCHW layout for API parity — XLA relayouts internally for the MXU, so no
+NHWC is forced on the user. Loss layers reproduce the reference's
+"backward-emits-the-gradient" contract via jax.custom_vjp
+(ref: src/operator/softmax_output-inl.h, regression_output-inl.h,
+make_loss-inl.h): their backward ignores the incoming out_grad exactly like
+the reference.
+
+Layer ops with learnable inputs provide custom infer_shape so simple_bind can
+complete weight shapes from the data shape (ref: nnvm InferShape pass use in
+src/executor/graph_executor.cc:428-445).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_float, attr_int, attr_tuple, attr_str, MXNetError
+from .registry import OpDef, register, register_def
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/fully_connected-inl.h:113-131)
+# ---------------------------------------------------------------------------
+
+def _fc_inputs(attrs):
+    if attr_bool(attrs.get("no_bias", False), False):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+def _fc_infer(attrs, in_shapes):
+    num_hidden = attr_int(attrs["num_hidden"])
+    no_bias = attr_bool(attrs.get("no_bias", False), False)
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("FullyConnected: data shape required")
+    in_units = 1
+    for d in data[1:]:
+        in_units *= d
+    shapes = [tuple(data), (num_hidden, in_units)]
+    if not no_bias:
+        shapes.append((num_hidden,))
+    return shapes, [(data[0], num_hidden)], []
+
+
+def _fc(op_ctx, attrs, inputs, aux):
+    num_hidden = attr_int(attrs["num_hidden"])
+    no_bias = attr_bool(attrs.get("no_bias", False), False)
+    data = inputs[0]
+    x = data.reshape(data.shape[0], -1)
+    w = inputs[1]
+    y = jnp.dot(x, w.T)
+    if not no_bias:
+        y = y + inputs[2]
+    return (y,)
+
+
+_FC = register_def(OpDef("FullyConnected", _fc, inputs=("data", "weight", "bias"),
+                         infer_shape=_fc_infer))
+_FC.list_inputs = _fc_inputs  # arity depends on no_bias
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (ref: src/operator/convolution-inl.h:570,
+# deconvolution-inl.h:669). CPU reference path is im2col+GEMM; here a single
+# lax.conv_general_dilated call that XLA tiles onto the MXU.
+# ---------------------------------------------------------------------------
+
+def _conv_attrs(attrs):
+    kernel = attr_tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = attr_tuple(attrs.get("stride", (1,) * nd), (1,) * nd)
+    dilate = attr_tuple(attrs.get("dilate", (1,) * nd), (1,) * nd)
+    pad = attr_tuple(attrs.get("pad", (0,) * nd), (0,) * nd)
+    num_filter = attr_int(attrs["num_filter"])
+    num_group = attr_int(attrs.get("num_group", 1), 1)
+    no_bias = attr_bool(attrs.get("no_bias", False), False)
+    return kernel, stride, dilate, pad, num_filter, num_group, no_bias
+
+
+def _conv_inputs(attrs):
+    if attr_bool(attrs.get("no_bias", False), False):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+def _conv_infer(attrs, in_shapes):
+    kernel, stride, dilate, pad, nf, ng, no_bias = _conv_attrs(attrs)
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("Convolution: data shape required")
+    c = data[1]
+    wshape = (nf, c // ng) + kernel
+    out_sp = tuple(
+        (data[2 + i] + 2 * pad[i] - dilate[i] * (kernel[i] - 1) - 1) // stride[i] + 1
+        for i in range(len(kernel)))
+    shapes = [tuple(data), wshape] + ([] if no_bias else [(nf,)])
+    return shapes, [(data[0], nf) + out_sp], []
+
+
+def _conv(op_ctx, attrs, inputs, aux):
+    kernel, stride, dilate, pad, nf, ng, no_bias = _conv_attrs(attrs)
+    x, w = inputs[0], inputs[1]
+    nd = len(kernel)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else
+        ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=ng,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if not no_bias:
+        b = inputs[2].reshape((1, nf) + (1,) * nd)
+        y = y + b
+    return (y,)
+
+
+_CONV = register_def(OpDef("Convolution", _conv, inputs=("data", "weight", "bias"),
+                           infer_shape=_conv_infer))
+_CONV.list_inputs = _conv_inputs
+
+
+def _deconv_infer(attrs, in_shapes):
+    kernel, stride, dilate, pad, nf, ng, no_bias = _conv_attrs(attrs)
+    adj = attr_tuple(attrs.get("adj", (0,) * len(kernel)), (0,) * len(kernel))
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("Deconvolution: data shape required")
+    c = data[1]
+    wshape = (c, nf // ng) + kernel
+    out_sp = tuple(
+        (data[2 + i] - 1) * stride[i] - 2 * pad[i]
+        + dilate[i] * (kernel[i] - 1) + 1 + adj[i]
+        for i in range(len(kernel)))
+    shapes = [tuple(data), wshape] + ([] if no_bias else [(nf,)])
+    return shapes, [(data[0], nf) + out_sp], []
+
+
+def _deconv(op_ctx, attrs, inputs, aux):
+    kernel, stride, dilate, pad, nf, ng, no_bias = _conv_attrs(attrs)
+    x, w = inputs[0], inputs[1]
+    nd = len(kernel)
+    # Deconvolution = gradient of convolution wrt data: lhs-dilated conv with
+    # transposed kernel (ref: deconvolution-inl.h backward-as-forward trick).
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, (w.shape[1] * ng, w.shape[0] // ng) + kernel,
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else
+        ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
+    # flip spatial dims, swap I/O
+    wt = jnp.swapaxes(w, 0, 1)
+    for i in range(nd):
+        wt = jnp.flip(wt, axis=2 + i)
+    if ng > 1:
+        # regroup for grouped transpose conv
+        ci, co = w.shape[0], w.shape[1]
+        wt = wt.reshape(co, ng, ci // ng, *kernel)
+        wt = wt.transpose(1, 0, 2, *range(3, 3 + nd))
+        wt = wt.reshape(ng * co, ci // ng, *kernel)
+    pads = [(dilate[i] * (kernel[i] - 1) - pad[i],
+             dilate[i] * (kernel[i] - 1) - pad[i]
+             + attr_tuple(attrs.get("adj", (0,) * nd), (0,) * nd)[i])
+            for i in range(nd)]
+    y = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=ng)
+    if not no_bias:
+        y = y + inputs[2].reshape((1, -1) + (1,) * nd)
+    return (y,)
+
+
+_DECONV = register_def(OpDef("Deconvolution", _deconv, inputs=("data", "weight", "bias"),
+                             infer_shape=_deconv_infer))
+_DECONV.list_inputs = _conv_inputs
+
+
+# ---------------------------------------------------------------------------
+# Activation / LeakyReLU / softmax (ref: activation-inl.h, leaky_relu-inl.h,
+# softmax_activation-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("Activation", inputs=("data",))
+def _activation(op_ctx, attrs, inputs, aux):
+    act = attr_str(attrs.get("act_type", "relu"), "relu")
+    x = inputs[0]
+    if act == "relu":
+        return (jax.nn.relu(x),)
+    if act == "sigmoid":
+        return (jax.nn.sigmoid(x),)
+    if act == "tanh":
+        return (jnp.tanh(x),)
+    if act == "softrelu":
+        return (jax.nn.softplus(x),)
+    raise MXNetError("Activation: unknown act_type %r" % act)
+
+
+def _leaky_inputs(attrs):
+    if attr_str(attrs.get("act_type", "leaky"), "leaky") == "prelu":
+        return ["data", "gamma"]
+    return ["data"]
+
+
+def _leaky_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("LeakyReLU: data shape required")
+    if attr_str(attrs.get("act_type", "leaky"), "leaky") == "prelu":
+        return [tuple(data), (data[1],)], [tuple(data)], []
+    return [tuple(data)], [tuple(data)], []
+
+
+def _leaky(op_ctx, attrs, inputs, aux):
+    act = attr_str(attrs.get("act_type", "leaky"), "leaky")
+    x = inputs[0]
+    slope = attr_float(attrs.get("slope", 0.25), 0.25)
+    if act == "leaky":
+        return (jnp.where(x > 0, x, slope * x),)
+    if act == "elu":
+        return (jnp.where(x > 0, x, slope * jnp.expm1(x)),)
+    if act == "prelu":
+        g = inputs[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return (jnp.where(x > 0, x, g * x),)
+    if act == "rrelu":
+        lo = attr_float(attrs.get("lower_bound", 0.125), 0.125)
+        up = attr_float(attrs.get("upper_bound", 0.334), 0.334)
+        if op_ctx.is_train and op_ctx.rng is not None:
+            s = jax.random.uniform(op_ctx.rng, x.shape, minval=lo, maxval=up,
+                                   dtype=x.dtype)
+        else:
+            s = (lo + up) / 2.0
+        return (jnp.where(x > 0, x, s * x),)
+    raise MXNetError("LeakyReLU: unknown act_type %r" % act)
+
+
+_LRELU = register_def(OpDef("LeakyReLU", _leaky, inputs=("data",), needs_rng=True,
+                            infer_shape=_leaky_infer))
+_LRELU.list_inputs = _leaky_inputs
+
+
+@register("SoftmaxActivation", inputs=("data",), aliases=("softmax",))
+def _softmax_activation(op_ctx, attrs, inputs, aux):
+    mode = attr_str(attrs.get("mode", "instance"), "instance")
+    x = inputs[0]
+    if mode == "channel":
+        return (jax.nn.softmax(x, axis=1),)
+    return (jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape),)
+
+
+@register("log_softmax", inputs=("data",))
+def _log_softmax(op_ctx, attrs, inputs, aux):
+    ax = attr_int(attrs.get("axis", -1), -1)
+    return (jax.nn.log_softmax(inputs[0], axis=ax),)
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (ref: src/operator/batch_norm-inl.h:358; aux moving_mean/var via
+# FMutateInputs). Functional form: returns aux *updates*, which the executor
+# writes back on forward (mirrors the reference's in-place aux mutation).
+# ---------------------------------------------------------------------------
+
+def _bn_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("BatchNorm: data shape required")
+    c = data[1] if len(data) > 1 else data[0]
+    out_mv = attr_bool(attrs.get("output_mean_var", False), False)
+    outs = [tuple(data)] + ([(c,), (c,)] if out_mv else [])
+    return [tuple(data), (c,), (c,)], outs, [(c,), (c,)]
+
+
+def _bn_outputs(attrs):
+    if attr_bool(attrs.get("output_mean_var", False), False):
+        return ["output", "mean", "var"]
+    return ["output"]
+
+
+def _batch_norm(op_ctx, attrs, inputs, aux):
+    eps = attr_float(attrs.get("eps", 1e-3), 1e-3)
+    momentum = attr_float(attrs.get("momentum", 0.9), 0.9)
+    fix_gamma = attr_bool(attrs.get("fix_gamma", True), True)
+    use_global = attr_bool(attrs.get("use_global_stats", False), False)
+    out_mv = attr_bool(attrs.get("output_mean_var", False), False)
+    x, gamma, beta = inputs
+    moving_mean, moving_var = aux
+    red = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if fix_gamma:
+        gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+    if op_ctx.is_train and not use_global:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+        new_mean = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
+        new_var = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
+        aux_updates = (new_mean, new_var)
+    else:
+        mean, var = moving_mean, moving_var
+        aux_updates = (moving_mean, moving_var)
+    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    y = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    outs = (y, mean, var) if out_mv else (y,)
+    return outs, aux_updates
+
+
+register_def(OpDef("BatchNorm", _batch_norm, inputs=("data", "gamma", "beta"),
+                   aux=("moving_mean", "moving_var"), infer_shape=_bn_infer,
+                   var_outputs=_bn_outputs))
+
+
+@register("InstanceNorm", inputs=("data", "gamma", "beta"))
+def _instance_norm(op_ctx, attrs, inputs, aux):
+    eps = attr_float(attrs.get("eps", 1e-3), 1e-3)
+    x, gamma, beta = inputs
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * gamma.reshape(bshape) + beta.reshape(bshape),)
+
+
+@register("L2Normalization", inputs=("data",))
+def _l2_normalization(op_ctx, attrs, inputs, aux):
+    eps = attr_float(attrs.get("eps", 1e-10), 1e-10)
+    mode = attr_str(attrs.get("mode", "instance"), "instance")
+    x = inputs[0]
+    if mode == "instance":
+        red = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    elif mode == "spatial":
+        red = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    else:
+        raise MXNetError("L2Normalization: unknown mode %r" % mode)
+    return (x / n,)
+
+
+@register("LRN", inputs=("data",))
+def _lrn(op_ctx, attrs, inputs, aux):
+    # ref: src/operator/lrn-inl.h — across-channel local response norm
+    alpha = attr_float(attrs.get("alpha", 1e-4), 1e-4)
+    beta = attr_float(attrs.get("beta", 0.75), 0.75)
+    knorm = attr_float(attrs.get("knorm", 2.0), 2.0)
+    nsize = attr_int(attrs.get("nsize", 5), 5)
+    x = inputs[0]
+    sq = jnp.square(x)
+    half = nsize // 2
+    win = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        window_dimensions=(1, nsize, 1, 1), window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (half, half), (0, 0), (0, 0)))
+    return (x * jnp.power(knorm + (alpha / nsize) * win, -beta),)
+
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/pooling-inl.h:316, nn/pool.h). avg pooling
+# divides by the constant kernel area (padding included), matching mshadow.
+# ---------------------------------------------------------------------------
+
+def _pool_out_dim(in_dim, k, s, p, convention):
+    if convention == "full":
+        import math
+        return int(math.ceil((in_dim + 2 * p - k) / float(s))) + 1
+    return (in_dim + 2 * p - k) // s + 1
+
+
+def _pool_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("Pooling: data shape required")
+    if attr_bool(attrs.get("global_pool", False), False):
+        return [tuple(data)], [tuple(data[:2]) + (1,) * (len(data) - 2)], []
+    kernel = attr_tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = attr_tuple(attrs.get("stride", (1,) * nd), (1,) * nd)
+    pad = attr_tuple(attrs.get("pad", (0,) * nd), (0,) * nd)
+    conv = attr_str(attrs.get("pooling_convention", "valid"), "valid")
+    out_sp = tuple(_pool_out_dim(data[2 + i], kernel[i], stride[i], pad[i], conv)
+                   for i in range(nd))
+    return [tuple(data)], [tuple(data[:2]) + out_sp], []
+
+
+def _pooling(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ptype = attr_str(attrs.get("pool_type", "max"), "max")
+    if attr_bool(attrs.get("global_pool", False), False):
+        red = tuple(range(2, x.ndim))
+        if ptype == "max":
+            return (jnp.max(x, axis=red, keepdims=True),)
+        if ptype == "sum":
+            return (jnp.sum(x, axis=red, keepdims=True),)
+        return (jnp.mean(x, axis=red, keepdims=True),)
+    kernel = attr_tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = attr_tuple(attrs.get("stride", (1,) * nd), (1,) * nd)
+    pad = attr_tuple(attrs.get("pad", (0,) * nd), (0,) * nd)
+    conv = attr_str(attrs.get("pooling_convention", "valid"), "valid")
+    # explicit padding incl. ceil-mode extra on the high side
+    pads = [(0, 0), (0, 0)]
+    for i in range(nd):
+        out = _pool_out_dim(x.shape[2 + i], kernel[i], stride[i], pad[i], conv)
+        needed = (out - 1) * stride[i] + kernel[i] - x.shape[2 + i]
+        pads.append((pad[i], max(pad[i], needed - pad[i])))
+    wdims = (1, 1) + kernel
+    wstrides = (1, 1) + stride
+    if ptype == "max":
+        # init must be a python literal, not a traced array — JAX's
+        # reduce_window vjp rule only fires on the recognized monoid
+        init = (-float("inf") if jnp.issubdtype(x.dtype, jnp.floating)
+                else int(jnp.iinfo(x.dtype).min))
+        y = jax.lax.reduce_window(x, init, jax.lax.max, wdims, wstrides, pads)
+        return (y,)
+    zero = 0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0
+    y = jax.lax.reduce_window(x, zero, jax.lax.add, wdims, wstrides, pads)
+    if ptype == "avg":
+        area = 1
+        for k in kernel:
+            area *= k
+        y = y / area
+    return (y,)
+
+
+register_def(OpDef("Pooling", _pooling, inputs=("data",), infer_shape=_pool_infer))
+
+
+@register("Dropout", inputs=("data",), needs_rng=True)
+def _dropout(op_ctx, attrs, inputs, aux):
+    p = attr_float(attrs.get("p", 0.5), 0.5)
+    x = inputs[0]
+    if not op_ctx.is_train or p <= 0.0:
+        return (x,)
+    if op_ctx.rng is None:
+        raise MXNetError("Dropout requires rng in training mode")
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(op_ctx.rng, keep, x.shape)
+    return (jnp.where(mask, x / keep, 0.0).astype(x.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Loss / output layers. Reference contract: forward transforms data; backward
+# *produces* d(loss)/d(data) ignoring out_grad (loss layers are graph heads).
+# ---------------------------------------------------------------------------
+
+def _softmax_out_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("SoftmaxOutput: data shape required")
+    multi = attr_bool(attrs.get("multi_output", False), False)
+    label = (data[0],) + tuple(data[2:]) if multi else (data[0],)
+    return [tuple(data), label], [tuple(data)], []
+
+
+@functools.lru_cache(maxsize=None)
+def _make_softmax_output(grad_scale, ignore_label, use_ignore, multi_output,
+                         normalization):
+    """custom_vjp closure over the static attrs (jax.custom_vjp args must all
+    be jax types)."""
+
+    def _softmax_fwd(data):
+        if multi_output:
+            return jax.nn.softmax(data, axis=1)
+        return jax.nn.softmax(data.reshape(data.shape[0], -1),
+                              axis=-1).reshape(data.shape)
+
+    @jax.custom_vjp
+    def softmax_output(data, label):
+        return _softmax_fwd(data)
+
+    def fwd(data, label):
+        out = _softmax_fwd(data)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        if multi_output:
+            lab = label.astype(jnp.int32)
+            oh = jax.nn.one_hot(lab, out.shape[1], axis=1, dtype=out.dtype)
+            grad = out - oh
+            valid = jnp.ones(lab.shape, out.dtype)
+            if use_ignore:
+                valid = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad * valid[:, None]
+        else:
+            lab = label.reshape(label.shape[0]).astype(jnp.int32)
+            oh = jax.nn.one_hot(lab, out.shape[1], dtype=out.dtype)
+            grad = out - oh.reshape(out.shape)
+            valid = jnp.ones(lab.shape, out.dtype)
+            if use_ignore:
+                valid = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad * valid.reshape((-1,) + (1,) * (out.ndim - 1))
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+        grad = grad * grad_scale
+        return (grad, jnp.zeros_like(label))
+
+    softmax_output.defvjp(fwd, bwd)
+    return softmax_output
+
+
+@register("SoftmaxOutput", inputs=("data", "label"),
+          infer_shape=_softmax_out_infer, aliases=("Softmax",))
+def _softmax_output(op_ctx, attrs, inputs, aux):
+    gs = attr_float(attrs.get("grad_scale", 1.0), 1.0)
+    il = attr_float(attrs.get("ignore_label", -1.0), -1.0)
+    ui = attr_bool(attrs.get("use_ignore", False), False)
+    mo = attr_bool(attrs.get("multi_output", False), False)
+    norm = attr_str(attrs.get("normalization", "null"), "null")
+    fn = _make_softmax_output(gs, il, ui, mo, norm)
+    return (fn(inputs[0], inputs[1]),)
+
+
+def _regression_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("regression output: data shape required")
+    return [tuple(data), tuple(data)], [tuple(data)], []
+
+
+@functools.lru_cache(maxsize=None)
+def _make_regression(kind, grad_scale):
+    transform = {"linear": lambda x: x, "logistic": jax.nn.sigmoid,
+                 "mae": lambda x: x}[kind]
+    grad_fn = {"linear": lambda o, l: (o - l),
+               "logistic": lambda o, l: (o - l),
+               "mae": lambda o, l: jnp.sign(o - l)}[kind]
+
+    @jax.custom_vjp
+    def reg(data, label):
+        return transform(data)
+
+    def fwd(data, label):
+        out = transform(data)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        grad = grad_fn(out, label.reshape(out.shape)) * grad_scale
+        return (grad, jnp.zeros_like(label))
+
+    reg.defvjp(fwd, bwd)
+    return reg
+
+
+def _reg_op(kind):
+    def op(op_ctx, attrs, inputs, aux):
+        gs = attr_float(attrs.get("grad_scale", 1.0), 1.0)
+        return (_make_regression(kind, gs)(inputs[0], inputs[1]),)
+    return op
+
+
+register_def(OpDef("LinearRegressionOutput", _reg_op("linear"),
+                   inputs=("data", "label"), infer_shape=_regression_infer))
+register_def(OpDef("LogisticRegressionOutput", _reg_op("logistic"),
+                   inputs=("data", "label"), infer_shape=_regression_infer))
+register_def(OpDef("MAERegressionOutput", _reg_op("mae"),
+                   inputs=("data", "label"), infer_shape=_regression_infer))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_loss_fn(grad_scale):
+    @jax.custom_vjp
+    def make_loss(data):
+        return data
+
+    def fwd(data):
+        return data, (data.shape, str(data.dtype))
+
+    def bwd(res, g):
+        shape, dtype = res
+        return (jnp.full(shape, grad_scale, jnp.dtype(dtype)),)
+
+    make_loss.defvjp(fwd, bwd)
+    return make_loss
+
+
+@register("MakeLoss", inputs=("data",))
+def _makeloss(op_ctx, attrs, inputs, aux):
+    gs = attr_float(attrs.get("grad_scale", 1.0), 1.0)
+    norm = attr_str(attrs.get("normalization", "null"), "null")
+    x = inputs[0]
+    if norm == "batch":
+        gs = gs / x.shape[0]
+    return (_make_loss_fn(gs)(x),)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_svm(margin, reg_coef, use_linear):
+    @jax.custom_vjp
+    def svm(data, label):
+        return data
+
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        lab = label.reshape(label.shape[0]).astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, data.shape[1], dtype=data.dtype)
+        score_correct = jnp.take_along_axis(data, lab[:, None], axis=1)
+        m = margin - (score_correct - data)
+        if use_linear:  # L1-SVM hinge
+            viol = (m > 0).astype(data.dtype) * (1 - oh)
+            grad = reg_coef * (viol - oh * jnp.sum(viol, axis=1, keepdims=True))
+        else:  # L2-SVM squared hinge
+            viol = jnp.maximum(m, 0) * (1 - oh)
+            grad = 2 * reg_coef * (viol - oh * jnp.sum(viol, axis=1,
+                                                       keepdims=True))
+        return (grad, jnp.zeros_like(label))
+
+    svm.defvjp(fwd, bwd)
+    return svm
+
+
+@register("SVMOutput", inputs=("data", "label"), infer_shape=_softmax_out_infer)
+def _svm_output(op_ctx, attrs, inputs, aux):
+    margin = attr_float(attrs.get("margin", 1.0), 1.0)
+    reg = attr_float(attrs.get("regularization_coefficient", 1.0), 1.0)
+    lin = attr_bool(attrs.get("use_linear", False), False)
+    return (_make_svm(margin, reg, lin)(inputs[0], inputs[1]),)
+
+
+# ---------------------------------------------------------------------------
+# Concat / SliceChannel (ref: concat-inl.h:244, slice_channel-inl.h:269)
+# ---------------------------------------------------------------------------
+
+def _concat_infer(attrs, in_shapes):
+    dim = attr_int(attrs.get("dim", 1), 1)
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        raise MXNetError("Concat: at least one input shape required")
+    base = list(known[0])
+    total = 0
+    filled = []
+    for s in in_shapes:
+        if s is None:
+            s = tuple(base)  # assume same as first (common weight-free case)
+        total += s[dim]
+        filled.append(tuple(s))
+    out = list(filled[0])
+    out[dim] = sum(s[dim] for s in filled)
+    return filled, [tuple(out)], []
+
+
+@register("Concat", var_inputs_attr="num_args", infer_shape=_concat_infer,
+          aliases=("concat",))
+def _concat(op_ctx, attrs, inputs, aux):
+    dim = attr_int(attrs.get("dim", 1), 1)
+    return (jnp.concatenate(inputs, axis=dim),)
+
+
+def _slice_channel_outputs(attrs):
+    n = attr_int(attrs.get("num_outputs", 1), 1)
+    return ["output%d" % i for i in range(n)]
+
+
+@register("SliceChannel", inputs=("data",), var_outputs=_slice_channel_outputs,
+          aliases=("split",))
+def _slice_channel(op_ctx, attrs, inputs, aux):
+    n = attr_int(attrs.get("num_outputs", 1), 1)
+    ax = attr_int(attrs.get("axis", 1), 1)
+    squeeze = attr_bool(attrs.get("squeeze_axis", False), False)
+    parts = jnp.split(inputs[0], n, axis=ax)
+    if squeeze:
+        parts = [p.squeeze(ax) for p in parts]
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# Pad / UpSampling / Crop (ref: pad.cc:735, upsampling-inl.h:318, crop-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("Pad", inputs=("data",), aliases=("pad",))
+def _pad(op_ctx, attrs, inputs, aux):
+    mode = attr_str(attrs.get("mode", "constant"), "constant")
+    pw = attr_tuple(attrs["pad_width"])
+    cv = attr_float(attrs.get("constant_value", 0.0), 0.0)
+    x = inputs[0]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
+    if mode == "constant":
+        return (jnp.pad(x, pairs, constant_values=cv),)
+    if mode == "edge":
+        return (jnp.pad(x, pairs, mode="edge"),)
+    if mode == "reflect":
+        return (jnp.pad(x, pairs, mode="reflect"),)
+    raise MXNetError("Pad: unknown mode %r" % mode)
+
+
+@register("UpSampling", var_inputs_attr="num_args", infer_shape=None)
+def _upsampling(op_ctx, attrs, inputs, aux):
+    scale = attr_int(attrs["scale"])
+    stype = attr_str(attrs.get("sample_type", "nearest"), "nearest")
+    if stype == "nearest":
+        outs = []
+        target = None
+        for x in inputs:
+            y = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+            if target is None:
+                target = y.shape[2:]
+            outs.append(y[:, :, :target[0], :target[1]])
+        return (jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0],)
+    raise MXNetError("UpSampling: sample_type %r not yet supported" % stype)
+
+
+def _crop_inputs(attrs):
+    n = attr_int(attrs.get("num_args", 1), 1)
+    return ["data", "crop_like"] if n == 2 else ["data"]
+
+
+def _crop(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    if len(inputs) == 2:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        hw = attr_tuple(attrs["h_w"])
+        th, tw = hw[0], hw[1]
+    if attr_bool(attrs.get("center_crop", False), False):
+        oy = (x.shape[2] - th) // 2
+        ox = (x.shape[3] - tw) // 2
+    else:
+        off = attr_tuple(attrs.get("offset", (0, 0)), (0, 0))
+        oy, ox = off[0], off[1]
+    return (x[:, :, oy:oy + th, ox:ox + tw],)
+
+
+_CROP = register_def(OpDef("Crop", _crop, inputs=("data",)))
+_CROP.list_inputs = _crop_inputs
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (ref: sequence_last/mask/reverse-inl.h). Sequence axis 0,
+# batch axis 1 — matching the reference's (T, N, ...) layout.
+# ---------------------------------------------------------------------------
+
+def _seq_inputs(attrs):
+    if attr_bool(attrs.get("use_sequence_length", False), False):
+        return ["data", "sequence_length"]
+    return ["data"]
+
+
+def _seq_op(name, fn):
+    od = register_def(OpDef(name, fn, inputs=("data",)))
+    od.list_inputs = _seq_inputs
+
+
+def _sequence_last(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    if len(inputs) == 2:
+        idx = (inputs[1].astype(jnp.int32) - 1).clip(0, x.shape[0] - 1)
+        return (jnp.take_along_axis(
+            x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0).squeeze(0),)
+    return (x[-1],)
+
+
+def _sequence_mask(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    val = attr_float(attrs.get("value", 0.0), 0.0)
+    if len(inputs) == 1:
+        return (x,)
+    t = jnp.arange(x.shape[0]).reshape((-1, 1) + (1,) * (x.ndim - 2))
+    mask = t < inputs[1].astype(jnp.int32).reshape((1, -1) + (1,) * (x.ndim - 2))
+    return (jnp.where(mask, x, val).astype(x.dtype),)
+
+
+def _sequence_reverse(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    if len(inputs) == 1:
+        return (jnp.flip(x, axis=0),)
+    seq_len = inputs[1].astype(jnp.int32)
+    t = jnp.arange(x.shape[0])[:, None]
+    rev_idx = jnp.where(t < seq_len[None, :], seq_len[None, :] - 1 - t, t)
+    return (jnp.take_along_axis(
+        x, rev_idx.reshape(rev_idx.shape + (1,) * (x.ndim - 2)), axis=0),)
+
+
+_seq_op("SequenceLast", _sequence_last)
+_seq_op("SequenceMask", _sequence_mask)
+_seq_op("SequenceReverse", _sequence_reverse)
+
+
+@register("IdentityAttachKLSparseReg", inputs=("data",))
+def _id_kl_sparse(op_ctx, attrs, inputs, aux):
+    return (inputs[0],)

@@ -1,0 +1,109 @@
+"""Fused optimizer update operators.
+
+Re-design of the reference's in-graph update ops (ref:
+src/operator/optimizer_op-inl.h:425 — sgd_update, sgd_mom_update, adam_update,
+rmsprop_update, rmspropalex_update registered as NNVM ops so updates run
+device-side). Here each is a single jnp expression XLA fuses into one kernel;
+the Module fused train step inlines them into the same jit as fwd+bwd.
+
+All follow the reference semantics. SGD clips the rescaled gradient before
+adding weight decay (ref: sgd_update); Adam/RMSProp add wd*weight first and
+clip the sum (ref: python optimizer.py Adam/RMSProp).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import attr_float
+from .registry import OpDef, register_def
+
+
+def _common(attrs):
+    lr = attr_float(attrs.get("lr"))
+    wd = attr_float(attrs.get("wd", 0.0), 0.0)
+    rescale = attr_float(attrs.get("rescale_grad", 1.0), 1.0)
+    clip = attr_float(attrs.get("clip_gradient", -1.0), -1.0)
+    return lr, wd, rescale, clip
+
+
+def _prep_grad(grad, rescale, clip):
+    g = grad * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _sgd_update(op_ctx, attrs, inputs, aux):
+    weight, grad = inputs
+    lr, wd, rescale, clip = _common(attrs)
+    g = _prep_grad(grad, rescale, clip)
+    return (weight - lr * (g + wd * weight),)
+
+
+def _sgd_mom_update(op_ctx, attrs, inputs, aux):
+    weight, grad, mom = inputs
+    lr, wd, rescale, clip = _common(attrs)
+    momentum = attr_float(attrs.get("momentum", 0.0), 0.0)
+    g = _prep_grad(grad, rescale, clip)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return (weight + new_mom, new_mom)
+
+
+def _adam_update(op_ctx, attrs, inputs, aux):
+    weight, grad, mean, var = inputs
+    lr, wd, rescale, clip = _common(attrs)
+    beta1 = attr_float(attrs.get("beta1", 0.9), 0.9)
+    beta2 = attr_float(attrs.get("beta2", 0.999), 0.999)
+    eps = attr_float(attrs.get("epsilon", 1e-8), 1e-8)
+    g = _prep_grad(grad * rescale + wd * weight, 1.0, clip)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_weight = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return (new_weight, new_mean, new_var)
+
+
+def _rmsprop_update(op_ctx, attrs, inputs, aux):
+    weight, grad, n = inputs
+    lr, wd, rescale, clip = _common(attrs)
+    gamma1 = attr_float(attrs.get("gamma1", 0.95), 0.95)
+    eps = attr_float(attrs.get("epsilon", 1e-8), 1e-8)
+    clip_w = attr_float(attrs.get("clip_weights", -1.0), -1.0)
+    g = _prep_grad(grad * rescale + wd * weight, 1.0, clip)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_weight = weight - lr * g / jnp.sqrt(new_n + eps)
+    if clip_w is not None and clip_w > 0:
+        new_weight = jnp.clip(new_weight, -clip_w, clip_w)
+    return (new_weight, new_n)
+
+
+def _rmspropalex_update(op_ctx, attrs, inputs, aux):
+    # centered RMSProp (ref: rmspropalex_update, Graves 2013 variant)
+    weight, grad, n, g_avg, delta = inputs
+    lr, wd, rescale, clip = _common(attrs)
+    gamma1 = attr_float(attrs.get("gamma1", 0.95), 0.95)
+    gamma2 = attr_float(attrs.get("gamma2", 0.9), 0.9)
+    eps = attr_float(attrs.get("epsilon", 1e-8), 1e-8)
+    clip_w = attr_float(attrs.get("clip_weights", -1.0), -1.0)
+    g = _prep_grad(grad * rescale + wd * weight, 1.0, clip)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_avg
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + eps)
+    new_weight = weight + new_delta
+    if clip_w is not None and clip_w > 0:
+        new_weight = jnp.clip(new_weight, -clip_w, clip_w)
+    return (new_weight, new_n, new_g, new_delta)
+
+
+register_def(OpDef("sgd_update", _sgd_update, inputs=("weight", "grad")))
+register_def(OpDef("sgd_mom_update", _sgd_mom_update,
+                   inputs=("weight", "grad", "mom"),
+                   outputs=("weight", "mom")))
+register_def(OpDef("adam_update", _adam_update,
+                   inputs=("weight", "grad", "mean", "var"),
+                   outputs=("weight", "mean", "var")))
+register_def(OpDef("rmsprop_update", _rmsprop_update,
+                   inputs=("weight", "grad", "n"),
+                   outputs=("weight", "n")))
+register_def(OpDef("rmspropalex_update", _rmspropalex_update,
+                   inputs=("weight", "grad", "n", "g", "delta"),
+                   outputs=("weight", "n", "g", "delta")))

@@ -1,0 +1,207 @@
+"""Operator registry.
+
+The reference has four registration systems (legacy OperatorProperty,
+NNVM_REGISTER_OP, the elementwise macro family, and the simple-op registry —
+SURVEY.md section 2.3). Here there is exactly ONE: an ``OpDef`` holding a pure
+JAX function plus declarative metadata. From a single registration the
+framework derives:
+
+- the imperative NDArray wrapper  (ref: _init_ndarray_module autogen)
+- the symbolic Symbol constructor (ref: _init_symbol_module autogen)
+- shape/type inference            (ref: nnvm InferShape/InferType passes) —
+  by default via ``jax.eval_shape`` abstract evaluation; layer ops with
+  learnable inputs override ``infer_shape`` so parameter shapes can be
+  *completed* from the data shape (what simple_bind relies on).
+
+Gradients need no per-op registration at all: executors differentiate the
+composed pure function with ``jax.vjp``. Ops whose reference backward is NOT
+the mathematical vjp (loss layers like SoftmaxOutput, ref:
+src/operator/softmax_output-inl.h) use ``jax.custom_vjp`` inside their fn.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+
+
+class OpContext(object):
+    """Per-invocation context threaded into op kernels.
+
+    Carries ``is_train`` (ref: OpContext.is_train, include/mxnet/operator.h)
+    and a functional PRNG key for ops that declared ``needs_rng`` (ref:
+    ResourceRequest::kRandom).
+    """
+
+    __slots__ = ("is_train", "rng")
+
+    def __init__(self, is_train=False, rng=None):
+        self.is_train = is_train
+        self.rng = rng
+
+
+class OpDef(object):
+    """A registered operator."""
+
+    def __init__(self, name, fn, inputs=("data",), aux=(), outputs=("output",),
+                 infer_shape=None, infer_type=None, needs_rng=False,
+                 var_inputs_attr=None, var_inputs_prefix="arg",
+                 var_outputs=None, description=""):
+        self.name = name
+        self.fn = fn  # fn(op_ctx, attrs, inputs:list, aux:list) -> tuple | (tuple, aux_updates)
+        self._inputs = tuple(inputs)
+        self._aux = tuple(aux)
+        self._outputs = tuple(outputs)
+        self._infer_shape = infer_shape
+        self._infer_type = infer_type
+        self.needs_rng = needs_rng
+        self.var_inputs_attr = var_inputs_attr   # e.g. "num_args" for Concat
+        self.var_inputs_prefix = var_inputs_prefix
+        self.var_outputs = var_outputs           # callable(attrs)->list[str] or None
+        self.description = description
+
+    # -- arity ----------------------------------------------------------
+    def list_inputs(self, attrs):
+        if self.var_inputs_attr is not None:
+            n = int(attrs.get(self.var_inputs_attr, 1))
+            return ["%s%d" % (self.var_inputs_prefix, i) for i in range(n)]
+        return list(self._inputs)
+
+    def list_aux(self, attrs):
+        return list(self._aux)
+
+    def list_outputs(self, attrs):
+        if self.var_outputs is not None:
+            return list(self.var_outputs(attrs))
+        return list(self._outputs)
+
+    def num_outputs(self, attrs):
+        return len(self.list_outputs(attrs))
+
+    # -- execution ------------------------------------------------------
+    def apply(self, op_ctx, attrs, inputs, aux):
+        """Run the kernel. Returns (outputs_tuple, aux_updates_tuple|None)."""
+        out = self.fn(op_ctx, attrs, list(inputs), list(aux))
+        if (isinstance(out, tuple) and len(out) == 2
+                and isinstance(out[0], (tuple, list))
+                and isinstance(out[1], (tuple, list))):
+            return tuple(out[0]), tuple(out[1])
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return tuple(out), None
+
+    # -- inference ------------------------------------------------------
+    def infer_shape(self, attrs, in_shapes, aux_shapes=None):
+        """Complete shapes. ``in_shapes``: list of tuple|None per input.
+        Returns (in_shapes, out_shapes, aux_shapes); raises if underdetermined.
+        """
+        if self._infer_shape is not None:
+            return self._infer_shape(attrs, list(in_shapes))
+        if any(s is None for s in in_shapes):
+            missing = [self.list_inputs(attrs)[i]
+                       for i, s in enumerate(in_shapes) if s is None]
+            raise MXNetError(
+                "op %s: cannot infer shapes of inputs %s (no custom infer_shape)"
+                % (self.name, missing))
+        outs = self._abstract_eval(attrs, in_shapes)
+        return list(in_shapes), [tuple(o.shape) for o in outs], []
+
+    def infer_type(self, attrs, in_dtypes):
+        if self._infer_type is not None:
+            return self._infer_type(attrs, list(in_dtypes))
+        known = [d for d in in_dtypes if d is not None]
+        dt = known[0] if known else _np.float32
+        n_in = len(in_dtypes)
+        return ([dt] * n_in,
+                [dt] * self.num_outputs(attrs),
+                [dt] * len(self._aux))
+
+    def _abstract_eval(self, attrs, in_shapes, in_dtypes=None):
+        n = len(in_shapes)
+        if in_dtypes is None:
+            in_dtypes = [jnp.float32] * n
+        args = [jax.ShapeDtypeStruct(tuple(s), d)
+                for s, d in zip(in_shapes, in_dtypes)]
+        aux_shapes = []  # abstract eval with no aux only valid for aux-free ops
+        ctx = OpContext(is_train=False, rng=None)
+
+        def run(*arrs):
+            outs, _ = self.apply(ctx, attrs, list(arrs), [])
+            return outs
+
+        try:
+            return jax.eval_shape(run, *args)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            raise MXNetError("op %s: abstract shape eval failed for %s: %s"
+                             % (self.name, in_shapes, e))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+_REGISTRY = {}
+_ALIASES = {}
+
+
+def register(name, **kwargs):
+    """Decorator: register ``fn(op_ctx, attrs, inputs, aux)`` as operator ``name``."""
+    aliases = kwargs.pop("aliases", ())
+
+    def deco(fn):
+        opdef = OpDef(name, fn, **kwargs)
+        _REGISTRY[name] = opdef
+        for a in aliases:
+            _ALIASES[a] = name
+        return fn
+    return deco
+
+
+def register_def(opdef, aliases=()):
+    _REGISTRY[opdef.name] = opdef
+    for a in aliases:
+        _ALIASES[a] = opdef.name
+    return opdef
+
+
+def get(name):
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    if name in _ALIASES:
+        return _REGISTRY[_ALIASES[name]]
+    raise MXNetError("operator %r is not registered" % (name,))
+
+
+def exists(name):
+    return name in _REGISTRY or name in _ALIASES
+
+
+def list_ops():
+    return sorted(set(_REGISTRY) | set(_ALIASES))
+
+
+# ---------------------------------------------------------------------------
+# light-weight helpers for bulk registration of pure-jnp ops
+# ---------------------------------------------------------------------------
+
+def register_unary(name, jfn, aliases=()):
+    """Elementwise unary op (ref: MXNET_OPERATOR_REGISTER_UNARY family)."""
+    def fn(op_ctx, attrs, inputs, aux):
+        return (jfn(inputs[0]),)
+    register_def(OpDef(name, fn, inputs=("data",)), aliases=aliases)
+
+
+def register_binary(name, jfn, aliases=()):
+    """Elementwise binary op, same-shape (ref: elemwise_binary_op.h)."""
+    def fn(op_ctx, attrs, inputs, aux):
+        return (jfn(inputs[0], inputs[1]),)
+    register_def(OpDef(name, fn, inputs=("lhs", "rhs")), aliases=aliases)
+
+
+def register_binary_scalar(name, jfn, aliases=()):
+    """lhs op scalar-attr (ref: elemwise_binary_scalar_op.h, attr 'scalar')."""
+    def fn(op_ctx, attrs, inputs, aux):
+        s = float(attrs.get("scalar", 0.0))
+        return (jfn(inputs[0], s),)
+    register_def(OpDef(name, fn, inputs=("data",)), aliases=aliases)

@@ -1,0 +1,598 @@
+"""Tensor operators: elementwise, broadcast, reduce, matrix, indexing,
+ordering, init, sampling, control flow.
+
+TPU-native coverage of the reference's tensor op menu
+(ref: src/operator/tensor/elemwise_*_op*, broadcast_reduce_op.h,
+matrix_op-inl.h, indexing_op.h, ordering_op-inl.h, init_op.h, sample_op.h,
+control_flow_op.h; functor menu ref: src/operator/mshadow_op.h). Every kernel
+is a pure jnp/lax emission — XLA fuses the elementwise chains that the
+reference's engine bulked into segments, and gradients come from jax.vjp
+instead of registered _backward_* ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln as _gammaln
+
+from ..base import attr_bool, attr_float, attr_int, attr_tuple, MXNetError
+from .registry import (OpDef, register, register_def, register_unary,
+                       register_binary, register_binary_scalar)
+
+# ---------------------------------------------------------------------------
+# unary math menu (ref: mshadow_op.h:1-892)
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint,
+    "ceil": jnp.ceil, "floor": jnp.floor, "round": jnp.round,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x), "exp": jnp.exp,
+    "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "gamma": lambda x: jnp.exp(_gammaln(x)), "gammaln": _gammaln,
+    "negative": jnp.negative, "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu, "softsign": jax.nn.soft_sign,
+    "erf": jax.lax.erf, "reciprocal": jnp.reciprocal,
+}
+for _n, _f in _UNARY.items():
+    register_unary(_n, _f)
+
+register_unary("identity", lambda x: x, aliases=("_copy",))
+
+
+@register("BlockGrad", inputs=("data",), aliases=("stop_gradient",))
+def _block_grad(op_ctx, attrs, inputs, aux):
+    return (jax.lax.stop_gradient(inputs[0]),)
+
+
+@register("Cast", inputs=("data",), aliases=("cast",))
+def _cast(op_ctx, attrs, inputs, aux):
+    return (inputs[0].astype(jnp.dtype(str(attrs["dtype"]))),)
+
+
+@register("clip", inputs=("data",))
+def _clip(op_ctx, attrs, inputs, aux):
+    return (jnp.clip(inputs[0], attr_float(attrs.get("a_min")),
+                     attr_float(attrs.get("a_max"))),)
+
+
+@register("smooth_l1", inputs=("data",))
+def _smooth_l1(op_ctx, attrs, inputs, aux):
+    # ref: mshadow_op.h smooth_l1_loss — f(x)=0.5(sx)^2 if |x|<1/s^2 else |x|-0.5/s^2
+    s = attr_float(attrs.get("scalar", 1.0), 1.0)
+    x = inputs[0]
+    s2 = s * s
+    return (jnp.where(jnp.abs(x) < 1.0 / s2,
+                      0.5 * s2 * x * x,
+                      jnp.abs(x) - 0.5 / s2),)
+
+
+# ---------------------------------------------------------------------------
+# binary: same-shape elemwise (ref: elemwise_binary_op.h), broadcast
+# (ref: elemwise_binary_broadcast_op.h), scalar (ref: *_scalar_op.h)
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "power": jnp.power, "maximum": jnp.maximum,
+    "minimum": jnp.minimum, "hypot": jnp.hypot, "mod": jnp.mod,
+    "equal": lambda a, b: (a == b).astype(a.dtype),
+    "not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "greater": lambda a, b: (a > b).astype(a.dtype),
+    "greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "lesser": lambda a, b: (a < b).astype(a.dtype),
+    "lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+}
+for _n, _f in _BINARY.items():
+    register_binary("_" + _n, _f, aliases=("elemwise_" + _n,))
+    register_binary("broadcast_" + _n, _f)
+
+register_binary("_plus", jnp.add)
+register_binary("_minus", jnp.subtract)
+register_binary("broadcast_plus", jnp.add)
+register_binary("broadcast_minus", jnp.subtract)
+register_binary("_grad_add", jnp.add)
+
+for _n, _f in _BINARY.items():
+    register_binary_scalar("_%s_scalar" % _n, _f)
+register_binary_scalar("_plus_scalar", jnp.add)
+register_binary_scalar("_minus_scalar", jnp.subtract)
+register_binary_scalar("_rminus_scalar", lambda x, s: s - x)
+register_binary_scalar("_rdiv_scalar", lambda x, s: s / x)
+register_binary_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
+register_binary_scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+register_binary_scalar("_maximum_scalar", jnp.maximum)
+register_binary_scalar("_minimum_scalar", jnp.minimum)
+register_binary_scalar("_hypot_scalar", jnp.hypot)
+
+
+# ---------------------------------------------------------------------------
+# reductions (ref: tensor/broadcast_reduce_op.h)
+# ---------------------------------------------------------------------------
+
+def _parse_axis(attrs, ndim):
+    ax = attrs.get("axis", None)
+    if ax is None or ax == "":
+        return None
+    ax = attr_tuple(ax)
+    return tuple(a % ndim for a in ax)
+
+
+def _register_reduce(name, jfn, aliases=()):
+    def fn(op_ctx, attrs, inputs, aux):
+        x = inputs[0]
+        axis = _parse_axis(attrs, x.ndim)
+        keepdims = attr_bool(attrs.get("keepdims", False), False)
+        return (jfn(x, axis=axis, keepdims=keepdims),)
+    register_def(OpDef(name, fn, inputs=("data",)), aliases=aliases)
+
+
+_register_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_register_reduce("mean", jnp.mean)
+_register_reduce("prod", jnp.prod)
+_register_reduce("nansum", jnp.nansum)
+_register_reduce("nanprod", jnp.nanprod)
+_register_reduce("max", jnp.max, aliases=("max_axis",))
+_register_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+def _register_arg_reduce(name, jfn):
+    def fn(op_ctx, attrs, inputs, aux):
+        x = inputs[0]
+        ax = attrs.get("axis", None)
+        keepdims = attr_bool(attrs.get("keepdims", False), False)
+        if ax is None or ax == "":
+            # ref semantics: flatten, return float index
+            r = jfn(x.reshape(-1))
+            return (r.astype(x.dtype),)
+        ax = attr_int(ax) % x.ndim
+        r = jfn(x, axis=ax)
+        if keepdims:
+            r = jnp.expand_dims(r, ax)
+        return (r.astype(x.dtype),)
+    register_def(OpDef(name, fn, inputs=("data",)))
+
+
+_register_arg_reduce("argmax", jnp.argmax)
+_register_arg_reduce("argmin", jnp.argmin)
+
+
+@register("argmax_channel", inputs=("data",))
+def _argmax_channel(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    return (jnp.argmax(x, axis=1).astype(x.dtype),)
+
+
+@register("norm", inputs=("data",))
+def _norm(op_ctx, attrs, inputs, aux):
+    # ref: L2 norm of the whole array -> scalar shape (1,)
+    x = inputs[0]
+    return (jnp.sqrt(jnp.sum(jnp.square(x))).reshape(1),)
+
+
+# ---------------------------------------------------------------------------
+# broadcast shape ops
+# ---------------------------------------------------------------------------
+
+@register("broadcast_to", inputs=("data",))
+def _broadcast_to(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    shape = attr_tuple(attrs["shape"])
+    # ref semantics: 0 in target shape means keep existing dim
+    tgt = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return (jnp.broadcast_to(x, tgt),)
+
+
+@register("broadcast_axis", inputs=("data",), aliases=("broadcast_axes",))
+def _broadcast_axis(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    axes = attr_tuple(attrs["axis"])
+    sizes = attr_tuple(attrs["size"])
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a % x.ndim] = s
+    return (jnp.broadcast_to(x, tuple(tgt)),)
+
+
+# ---------------------------------------------------------------------------
+# matrix / shape manipulation (ref: tensor/matrix_op-inl.h)
+# ---------------------------------------------------------------------------
+
+def _reshape_target(shape_attr, src_shape):
+    """Implements the reference Reshape's special codes 0, -1, -2, -3, -4
+    (ref: matrix_op-inl.h ReshapeParam)."""
+    target = list(shape_attr)
+    src = list(src_shape)
+    out = []
+    src_idx = 0
+    i = 0
+    while i < len(target):
+        s = target[i]
+        if s == 0:
+            out.append(src[src_idx]); src_idx += 1
+        elif s == -1:
+            out.append(-1); src_idx += 1
+        elif s == -2:
+            out.extend(src[src_idx:]); src_idx = len(src)
+        elif s == -3:
+            out.append(src[src_idx] * src[src_idx + 1]); src_idx += 2
+        elif s == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            cur = src[src_idx]; src_idx += 1
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 2
+        else:
+            out.append(s); src_idx += 1
+        i += 1
+    return tuple(out)
+
+
+@register("Reshape", inputs=("data",), aliases=("reshape",))
+def _reshape(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    if "shape" in attrs and attrs["shape"] not in (None, ""):
+        tgt = _reshape_target(attr_tuple(attrs["shape"]), x.shape)
+    elif attr_bool(attrs.get("reverse", False), False):
+        raise MXNetError("Reshape: reverse without shape unsupported")
+    else:
+        raise MXNetError("Reshape requires shape attr")
+    return (jnp.reshape(x, tgt),)
+
+
+@register("Flatten", inputs=("data",), aliases=("flatten",))
+def _flatten(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    return (jnp.reshape(x, (x.shape[0], -1)),)
+
+
+@register("transpose", inputs=("data",))
+def _transpose(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    axes = attrs.get("axes", None)
+    axes = attr_tuple(axes) if axes not in (None, "", ()) else None
+    return (jnp.transpose(x, axes),)
+
+
+@register("expand_dims", inputs=("data",))
+def _expand_dims(op_ctx, attrs, inputs, aux):
+    return (jnp.expand_dims(inputs[0], attr_int(attrs["axis"])),)
+
+
+@register("SwapAxis", inputs=("data",), aliases=("swapaxes",))
+def _swapaxis(op_ctx, attrs, inputs, aux):
+    return (jnp.swapaxes(inputs[0], attr_int(attrs.get("dim1", 0), 0),
+                         attr_int(attrs.get("dim2", 0), 0)),)
+
+
+@register("slice", inputs=("data",), aliases=("crop",))
+def _slice(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    begin = attr_tuple(attrs["begin"])
+    end = attr_tuple(attrs["end"])
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return (x[idx],)
+
+
+@register("slice_axis", inputs=("data",))
+def _slice_axis(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ax = attr_int(attrs["axis"]) % x.ndim
+    b = attr_int(attrs["begin"], 0) or 0
+    e = attrs.get("end", None)
+    e = x.shape[ax] if e in (None, "None", "") else attr_int(e)
+    if b < 0:
+        b += x.shape[ax]
+    if e < 0:
+        e += x.shape[ax]
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(b, e)
+    return (x[tuple(idx)],)
+
+
+@register("flip", inputs=("data",), aliases=("reverse",))
+def _flip(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    axes = attr_tuple(attrs["axis"])
+    return (jnp.flip(x, axes),)
+
+
+@register("repeat", inputs=("data",))
+def _repeat(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    reps = attr_int(attrs["repeats"])
+    ax = attrs.get("axis", None)
+    ax = attr_int(ax) if ax not in (None, "", "None") else None
+    return (jnp.repeat(x, reps, axis=ax),)
+
+
+@register("tile", inputs=("data",))
+def _tile(op_ctx, attrs, inputs, aux):
+    return (jnp.tile(inputs[0], attr_tuple(attrs["reps"])),)
+
+
+def _dot_infer(attrs, in_shapes):
+    a, b = in_shapes
+    ta = attr_bool(attrs.get("transpose_a", False), False)
+    tb = attr_bool(attrs.get("transpose_b", False), False)
+    if a is None or b is None:
+        raise MXNetError("dot: both input shapes required")
+    ar = a[::-1] if ta else a
+    br = b[::-1] if tb else b
+    out = tuple(ar[:-1]) + tuple(br[1:])
+    return [list(in_shapes)[0], list(in_shapes)[1]], [out], []
+
+
+@register("dot", inputs=("lhs", "rhs"))
+def _dot(op_ctx, attrs, inputs, aux):
+    a, b = inputs
+    if attr_bool(attrs.get("transpose_a", False), False):
+        a = a.T
+    if attr_bool(attrs.get("transpose_b", False), False):
+        b = b.T
+    return (jnp.dot(a, b, preferred_element_type=jnp.float32
+                    if a.dtype == jnp.bfloat16 else None).astype(a.dtype),)
+
+
+@register("batch_dot", inputs=("lhs", "rhs"))
+def _batch_dot(op_ctx, attrs, inputs, aux):
+    a, b = inputs
+    if attr_bool(attrs.get("transpose_a", False), False):
+        a = jnp.swapaxes(a, -1, -2)
+    if attr_bool(attrs.get("transpose_b", False), False):
+        b = jnp.swapaxes(b, -1, -2)
+    return (jnp.matmul(a, b),)
+
+
+# ---------------------------------------------------------------------------
+# indexing & embedding (ref: tensor/indexing_op.h)
+# ---------------------------------------------------------------------------
+
+def _embedding_infer(attrs, in_shapes):
+    data, weight = in_shapes
+    in_dim = attr_int(attrs["input_dim"])
+    out_dim = attr_int(attrs["output_dim"])
+    weight = (in_dim, out_dim)
+    if data is None:
+        raise MXNetError("Embedding: data shape required")
+    return [data, weight], [tuple(data) + (out_dim,)], []
+
+
+@register("Embedding", inputs=("data", "weight"), infer_shape=_embedding_infer)
+def _embedding(op_ctx, attrs, inputs, aux):
+    data, weight = inputs
+    idx = data.astype(jnp.int32)
+    return (jnp.take(weight, idx, axis=0),)
+
+
+@register("take", inputs=("a", "indices"))
+def _take(op_ctx, attrs, inputs, aux):
+    a, idx = inputs
+    ax = attr_int(attrs.get("axis", 0), 0)
+    mode = str(attrs.get("mode", "clip"))
+    idx = idx.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[ax] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[ax])
+    return (jnp.take(a, idx, axis=ax),)
+
+
+@register("batch_take", inputs=("a", "indices"))
+def _batch_take(op_ctx, attrs, inputs, aux):
+    a, idx = inputs
+    return (jnp.take_along_axis(a, idx.astype(jnp.int32)[:, None],
+                                axis=1).squeeze(1),)
+
+
+@register("one_hot", inputs=("indices",))
+def _one_hot(op_ctx, attrs, inputs, aux):
+    depth = attr_int(attrs["depth"])
+    on_v = attr_float(attrs.get("on_value", 1.0), 1.0)
+    off_v = attr_float(attrs.get("off_value", 0.0), 0.0)
+    dt = jnp.dtype(str(attrs.get("dtype", "float32")))
+    idx = inputs[0].astype(jnp.int32)
+    oh = jax.nn.one_hot(idx, depth, dtype=dt)
+    return ((oh * (on_v - off_v) + off_v).astype(dt),)
+
+
+@register("where", inputs=("condition", "x", "y"))
+def _where(op_ctx, attrs, inputs, aux):
+    cond, x, y = inputs
+    if cond.ndim == 1 and x.ndim > 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return (jnp.where(cond != 0, x, y),)
+
+
+# ---------------------------------------------------------------------------
+# ordering (ref: tensor/ordering_op-inl.h)
+# ---------------------------------------------------------------------------
+
+def _topk_outputs(attrs):
+    rt = str(attrs.get("ret_typ", "indices"))
+    return ["output0", "output1"] if rt == "both" else ["output"]
+
+
+@register("topk", inputs=("data",), var_outputs=_topk_outputs)
+def _topk(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ax = attr_int(attrs.get("axis", -1), -1)
+    k = attr_int(attrs.get("k", 1), 1)
+    rt = str(attrs.get("ret_typ", "indices"))
+    is_ascend = attr_bool(attrs.get("is_ascend", False), False)
+    ax = ax % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    vals, idxs = jax.lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax).astype(x.dtype)
+    if rt == "value":
+        return (vals,)
+    if rt == "both":
+        return (vals, idxs)
+    if rt == "mask":
+        raise MXNetError("topk ret_typ=mask not yet supported")
+    return (idxs,)
+
+
+@register("sort", inputs=("data",))
+def _sort(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ax = attr_int(attrs.get("axis", -1), -1)
+    asc = attr_bool(attrs.get("is_ascend", True), True)
+    r = jnp.sort(x, axis=ax)
+    return (r if asc else jnp.flip(r, axis=ax),)
+
+
+@register("argsort", inputs=("data",))
+def _argsort(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ax = attr_int(attrs.get("axis", -1), -1)
+    asc = attr_bool(attrs.get("is_ascend", True), True)
+    r = jnp.argsort(x, axis=ax)
+    if not asc:
+        r = jnp.flip(r, axis=ax)
+    return (r.astype(x.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# init ops (ref: tensor/init_op.h) — imperative-only creators also route here
+# ---------------------------------------------------------------------------
+
+def _creation_shape_infer(attrs, in_shapes):
+    shape = attr_tuple(attrs.get("shape", (1,)), (1,))
+    return [], [shape], []
+
+
+def _register_filler(name, fill):
+    def fn(op_ctx, attrs, inputs, aux):
+        shape = attr_tuple(attrs["shape"])
+        dt = jnp.dtype(str(attrs.get("dtype", "float32")))
+        return (jnp.full(shape, fill, dtype=dt),)
+    register_def(OpDef(name, fn, inputs=(), infer_shape=_creation_shape_infer))
+
+
+_register_filler("_zeros", 0)
+_register_filler("_ones", 1)
+
+
+@register("_full", inputs=(), infer_shape=_creation_shape_infer)
+def _full(op_ctx, attrs, inputs, aux):
+    shape = attr_tuple(attrs["shape"])
+    dt = jnp.dtype(str(attrs.get("dtype", "float32")))
+    return (jnp.full(shape, attr_float(attrs.get("value", 0.0), 0.0), dtype=dt),)
+
+
+@register("zeros_like", inputs=("data",))
+def _zeros_like(op_ctx, attrs, inputs, aux):
+    return (jnp.zeros_like(inputs[0]),)
+
+
+@register("ones_like", inputs=("data",))
+def _ones_like(op_ctx, attrs, inputs, aux):
+    return (jnp.ones_like(inputs[0]),)
+
+
+def _arange_infer(attrs, in_shapes):
+    start = attr_float(attrs.get("start", 0.0), 0.0)
+    stop = attrs.get("stop", None)
+    step = attr_float(attrs.get("step", 1.0), 1.0)
+    rep = attr_int(attrs.get("repeat", 1), 1)
+    if stop in (None, "None", ""):
+        start, stop = 0.0, start
+    else:
+        stop = attr_float(stop)
+    import math
+    n = max(0, int(math.ceil((stop - start) / step)))
+    return [], [(n * rep,)], []
+
+
+@register("_arange", inputs=(), infer_shape=_arange_infer)
+def _arange(op_ctx, attrs, inputs, aux):
+    start = attr_float(attrs.get("start", 0.0), 0.0)
+    stop = attrs.get("stop", None)
+    step = attr_float(attrs.get("step", 1.0), 1.0)
+    rep = attr_int(attrs.get("repeat", 1), 1)
+    dt = jnp.dtype(str(attrs.get("dtype", "float32")))
+    if stop in (None, "None", ""):
+        start, stop = 0.0, start
+    else:
+        stop = attr_float(stop)
+    r = jnp.arange(start, stop, step, dtype=dt)
+    if rep > 1:
+        r = jnp.repeat(r, rep)
+    return (r,)
+
+
+# ---------------------------------------------------------------------------
+# random sampling (ref: tensor/sample_op.h) — functional PRNG, needs_rng
+# ---------------------------------------------------------------------------
+
+def _register_sample(name, draw, aliases=()):
+    def fn(op_ctx, attrs, inputs, aux):
+        if op_ctx.rng is None:
+            raise MXNetError("op %s requires a PRNG key (rng resource)" % name)
+        shape = attr_tuple(attrs.get("shape", (1,)), (1,))
+        dt = jnp.dtype(str(attrs.get("dtype", "float32")))
+        return (draw(op_ctx.rng, attrs, shape, dt),)
+    register_def(OpDef(name, fn, inputs=(), needs_rng=True,
+                       infer_shape=_creation_shape_infer), aliases=aliases)
+
+
+_register_sample(
+    "_sample_uniform",
+    lambda key, attrs, shape, dt: jax.random.uniform(
+        key, shape, dtype=dt,
+        minval=attr_float(attrs.get("low", 0.0), 0.0),
+        maxval=attr_float(attrs.get("high", 1.0), 1.0)),
+    aliases=("uniform", "random_uniform", "_random_uniform"))
+
+_register_sample(
+    "_sample_normal",
+    lambda key, attrs, shape, dt: (
+        attr_float(attrs.get("loc", 0.0), 0.0)
+        + attr_float(attrs.get("scale", 1.0), 1.0)
+        * jax.random.normal(key, shape, dtype=dt)),
+    aliases=("normal", "random_normal", "_random_normal"))
+
+_register_sample(
+    "_sample_gamma",
+    lambda key, attrs, shape, dt: (
+        jax.random.gamma(key, attr_float(attrs.get("alpha", 1.0), 1.0),
+                         shape, dtype=dt)
+        * attr_float(attrs.get("beta", 1.0), 1.0)),
+    aliases=("_random_gamma",))
+
+_register_sample(
+    "_sample_exponential",
+    lambda key, attrs, shape, dt: (
+        jax.random.exponential(key, shape, dtype=dt)
+        / attr_float(attrs.get("lam", 1.0), 1.0)),
+    aliases=("_random_exponential",))
+
+_register_sample(
+    "_sample_poisson",
+    lambda key, attrs, shape, dt: jax.random.poisson(
+        key, attr_float(attrs.get("lam", 1.0), 1.0), shape).astype(dt),
+    aliases=("_random_poisson",))
+
+_register_sample(
+    "_sample_negbinomial",
+    lambda key, attrs, shape, dt: _neg_binomial(
+        key, attr_int(attrs.get("k", 1), 1),
+        attr_float(attrs.get("p", 1.0), 1.0), shape).astype(dt),
+    aliases=("_random_negative_binomial",))
+
+
+def _neg_binomial(key, k, p, shape):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam, shape)

@@ -1,0 +1,433 @@
+"""Optimizers (ref: python/mxnet/optimizer.py, 764 LoC).
+
+Same registry + Updater contract as the reference. The hot updates route
+through the fused update ops (mxnet_tpu.ops.optimizer_op — ref:
+src/operator/optimizer_op-inl.h), which the Module fused train step inlines
+into the same XLA computation as forward/backward; standalone imperative use
+works too. lr_mult/wd_mult resolution from symbol attrs matches
+optimizer.py:set_lr_mult/set_wd_mult.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import ndarray as nd
+
+
+def _zeros_like(weight):
+    """State buffer matching the weight's dtype AND sharding — on a mesh the
+    momentum/variance must be replicated exactly like the weight."""
+    import jax.numpy as jnp
+    return NDArray(jnp.zeros_like(weight.data))
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class Optimizer(object):
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = dict(param_idx2name)
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in _OPT_REGISTRY:
+            raise MXNetError("optimizer %r not registered" % name)
+        return _OPT_REGISTRY[name.lower()](**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    # -- lr / wd multipliers (attr-aware, ref: optimizer.py) ------------
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _base_attrs(self, index):
+        a = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+             "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            a["clip_gradient"] = self.clip_gradient
+        return a
+
+
+# create() factory (ref: mx.optimizer.create)
+def create(name, **kwargs):
+    return Optimizer.create_optimizer(name, **kwargs)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, via fused sgd(_mom)_update ops."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._base_attrs(index)
+        if state is not None:
+            attrs["momentum"] = self.momentum
+            new_w, new_m = nd.sgd_mom_update(weight, grad, state, **attrs)
+            weight._set_data(new_w.data)
+            state._set_data(new_m.data)
+        else:
+            new_w = nd.sgd_update(weight, grad, **attrs)
+            weight._set_data(new_w.data)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (ref: optimizer.py NAG)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            g += wd * weight
+            mom += g
+            g += self.momentum * mom
+            weight += -lr * g
+        else:
+            weight += -lr * (g + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (ref: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.normal(loc=0, scale=math.sqrt(lr), shape=weight.shape)
+        weight += -lr / 2 * (g + wd * weight) + noise
+
+
+@register
+class ccSGD(SGD):
+    """Kept for API parity; same math as SGD (ref: optimizer.py ccSGD)."""
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_zeros_like(weight),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mom, previous_weight = state
+        comp = g + wd * weight + self.lamda * g * g * (weight - previous_weight)
+        if mom is not None:
+            mom *= self.momentum
+            mom += -lr * comp
+            d = mom
+        else:
+            d = -lr * comp
+        previous_weight[:] = weight
+        weight += d
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight),
+                _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        attrs = self._base_attrs(index)
+        # bias correction folded into lr (ref: optimizer.py Adam)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        attrs["lr"] = attrs["lr"] * math.sqrt(coef2) / coef1
+        attrs.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        new_w, new_mean, new_var = nd.adam_update(weight, grad, mean, var, **attrs)
+        weight._set_data(new_w.data)
+        mean._set_data(new_mean.data)
+        var._set_data(new_var.data)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        history = state
+        history += g * g
+        weight += -lr * (g / nd.sqrt(history + self.float_stable_eps)
+                         + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight),
+                    _zeros_like(weight),
+                    _zeros_like(weight))
+        return (_zeros_like(weight),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._base_attrs(index)
+        attrs.update(gamma1=self.gamma1, epsilon=self.epsilon)
+        if self.clip_weights:
+            attrs["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            new_w, new_n = nd.rmsprop_update(weight, grad, n, **attrs)
+            weight._set_data(new_w.data)
+            n._set_data(new_n.data)
+        else:
+            n, g_avg, delta = state
+            attrs["gamma2"] = self.gamma2
+            new_w, new_n, new_g, new_d = nd.rmspropalex_update(
+                weight, grad, n, g_avg, delta, **attrs)
+            weight._set_data(new_w.data)
+            n._set_data(new_n.data)
+            g_avg._set_data(new_g.data)
+            delta._set_data(new_d.data)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight),
+                _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * g * g
+        current_delta = (nd.sqrt(acc_delta + self.epsilon)
+                         / nd.sqrt(acc_g + self.epsilon)) * g
+        acc_delta[:] = self.rho * acc_delta + (1.0 - self.rho) \
+            * current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight),  # z
+                _zeros_like(weight))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        z, n = state
+        sigma = -nd.sqrt(n)
+        n += g * g
+        sigma += nd.sqrt(n)
+        sigma /= lr
+        z += g - sigma * weight
+        # update weight
+        import numpy as _np
+        zn = z.asnumpy()
+        nn = n.asnumpy()
+        new_w = (_np.sign(zn) * self.lamda1 - zn) / \
+            ((self.beta + _np.sqrt(nn)) / lr + wd) * (_np.abs(zn) > self.lamda1)
+        weight[:] = new_w.astype(_np.float32)
+
+
+@register
+class Test(Optimizer):
+    """Adds a simple deterministic delta — for kvstore tests
+    (ref: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater(object):
+    """Stateful weight updater keyed by index (ref: optimizer.py Updater;
+    this is the object kvstore set_optimizer serializes to servers)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        import pickle
+
+        def dev(x):
+            if isinstance(x, np.ndarray):
+                return NDArray(x)
+            if isinstance(x, tuple):
+                return tuple(dev(i) for i in x)
+            return x
+        self.states = {k: dev(v) for k, v in pickle.loads(states).items()}
+
+    def get_states(self):
+        import pickle
+
+        def host(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, tuple):
+                return tuple(host(i) for i in x)
+            return x
+        return pickle.dumps({k: host(v) for k, v in self.states.items()})
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

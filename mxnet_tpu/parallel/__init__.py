@@ -1,0 +1,13 @@
+"""SPMD parallelism over the TPU mesh.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (ref: src/kvstore/, ps-lite, tools/launch.py — SURVEY.md
+§2.4/§5): parameter-server push/pull becomes in-step XLA collectives over a
+``jax.sharding.Mesh`` (psum for dist_sync gradient aggregation), launchers
+become ``jax.distributed.initialize``, and model-parallel ``group2ctx``
+placement becomes sharding annotations. Long-context parallelism (ring
+attention / sequence parallel) lives in mxnet_tpu.parallel.ring.
+"""
+from .mesh import (make_mesh, data_parallel_mesh, current_mesh, MeshScope,
+                   replicate, shard_batch, grad_sync)
+from . import ring  # noqa: F401

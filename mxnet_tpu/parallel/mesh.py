@@ -1,0 +1,96 @@
+"""Device-mesh helpers.
+
+The reference enumerates devices by (device_type, dev_id) and hand-routes
+communication (CommDevice GPU reduce, comm.h:211-373; ps-lite across hosts).
+Here placement is declarative: build a Mesh with named axes — 'data' (dp),
+'model' (tp), 'pipe' (pp), 'seq' (sp), 'expert' (ep) — annotate shardings,
+and XLA inserts the collectives that ride ICI within a slice and DCN across
+slices (the "How to Scale Your Model" recipe).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+
+P = jax.sharding.PartitionSpec
+
+_scope = threading.local()
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_PIPE = "pipe"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+
+def make_mesh(axis_shapes, devices=None):
+    """Create a Mesh from {'data': 4, 'model': 2, ...}.
+
+    Axis order follows insertion order; total size must equal device count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_shapes.keys())
+    shape = tuple(int(axis_shapes[n]) for n in names)
+    n = int(np.prod(shape))
+    if n != len(devices):
+        if n < len(devices):
+            devices = devices[:n]
+        else:
+            raise MXNetError("mesh needs %d devices, have %d"
+                             % (n, len(devices)))
+    arr = np.array(devices).reshape(shape)
+    return jax.sharding.Mesh(arr, names)
+
+
+def data_parallel_mesh(num=None, devices=None):
+    if devices is None:
+        devices = jax.devices()
+    if num is not None:
+        devices = devices[:num]
+    return make_mesh({AXIS_DATA: len(devices)}, devices)
+
+
+class MeshScope(object):
+    """with MeshScope(mesh): — sets the ambient mesh for Module/KVStore."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._old = getattr(_scope, "mesh", None)
+        _scope.mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *a):
+        _scope.mesh = self._old
+
+
+def current_mesh():
+    return getattr(_scope, "mesh", None)
+
+
+def replicate(tree, mesh):
+    """device_put a pytree replicated over the mesh."""
+    s = jax.sharding.NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)
+
+
+def shard_batch(tree, mesh, axis=AXIS_DATA):
+    """device_put a pytree with dim-0 sharded along the given mesh axis."""
+    def put(x):
+        spec = P(axis) if getattr(x, "ndim", 0) >= 1 else P()
+        return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, tree)
+
+
+def grad_sync(grads, axis_name=AXIS_DATA):
+    """Explicit gradient all-reduce for shard_map-style training steps —
+    the dist_sync kv.push+pull semantics as one psum over ICI
+    (ref: kvstore_dist.h sync mode; SURVEY.md §2.4)."""
+    return jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), grads)

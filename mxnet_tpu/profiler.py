@@ -1,0 +1,65 @@
+"""Profiler (ref: python/mxnet/profiler.py; C++ engine profiler at
+src/engine/profiler.{h,cc} emitting Chrome trace-event JSON).
+
+TPU-native substrate: jax.profiler captures XLA device traces (XPlane /
+TensorBoard format, which also opens in chrome://tracing-compatible viewers
+via Perfetto). The reference API shape — set_config, set_state, dump — is
+preserved; op names flow into the trace through jit scopes automatically.
+MXNET_PROFILER_AUTOSTART honored (ref: src/initialize.cc).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .base import MXNetError
+
+_state = {"running": False, "dir": "profile_output", "mode": "symbolic"}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Configure output location (ref: MXSetProfilerConfig). ``filename``'s
+    directory becomes the trace dir (XPlane traces are directories)."""
+    _state["mode"] = mode
+    d = os.path.dirname(filename) or "."
+    base = os.path.basename(filename)
+    _state["dir"] = os.path.join(d, base.replace(".json", "_trace"))
+
+
+def profiler_set_state(state="stop"):
+    """'run' starts the jax trace; 'stop' ends and writes it
+    (ref: MXSetProfilerState)."""
+    if state == "run" and not _state["running"]:
+        jax.profiler.start_trace(_state["dir"])
+        _state["running"] = True
+    elif state == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+    elif state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+
+
+def dump_profile():
+    """Finish the trace (ref: MXDumpProfile). XPlane output is written on
+    stop; this stops a running trace."""
+    if _state["running"]:
+        profiler_set_state("stop")
+
+
+class Scope(object):
+    """Named trace annotation for user code regions."""
+
+    def __init__(self, name):
+        self._t = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._t.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        self._t.__exit__(*a)
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    profiler_set_state("run")

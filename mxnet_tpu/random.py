@@ -1,0 +1,83 @@
+"""Random state for mxnet_tpu.
+
+The reference seeds per-device mshadow PRNGs through the ResourceManager
+(ref: src/resource.cc:127-135, C API MXRandomSeed). Here randomness is JAX
+functional PRNG: a module-level root key that is split on every imperative
+draw, and *threaded explicitly* through traced executor code (ops that
+declare ``needs_rng`` receive a fresh subkey derived from the executor's
+step counter, keeping jit-traced code deterministic and replayable).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _np
+
+_state = threading.local()
+
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global random number generator (parity: mx.random.seed)."""
+    _state.key = jax.random.key(int(seed_state))
+
+
+def split():
+    """Return a fresh PRNG subkey, advancing the global state."""
+    key, sub = jax.random.split(_get())
+    _state.key = key
+    return sub
+
+
+def np_rng():
+    """A numpy Generator seeded from the functional stream (host-side uses:
+    data shuffling, initializers that want numpy)."""
+    sub = split()
+    return _np.random.default_rng(_np.asarray(jax.random.key_data(sub))[-1])
+
+
+# ---------------------------------------------------------------------------
+# sampling API (ref: python/mxnet/random.py uniform/normal/...; the sample ops
+# themselves live in ops/tensor.py as _sample_*)
+# ---------------------------------------------------------------------------
+
+def _sample(op_name, out=None, **attrs):
+    from . import ndarray as nd
+    from .ops import registry as _reg
+    return nd.invoke(_reg.get(op_name), [], attrs, out=out)
+
+
+def uniform(low=0, high=1, shape=None, ctx=None, out=None):
+    return _sample("_sample_uniform", out=out, low=low, high=high,
+                   shape=shape or (1,))
+
+
+def normal(loc=0, scale=1, shape=None, ctx=None, out=None):
+    return _sample("_sample_normal", out=out, loc=loc, scale=scale,
+                   shape=shape or (1,))
+
+
+def gamma(alpha=1, beta=1, shape=None, ctx=None, out=None):
+    return _sample("_sample_gamma", out=out, alpha=alpha, beta=beta,
+                   shape=shape or (1,))
+
+
+def exponential(lam=1, shape=None, ctx=None, out=None):
+    return _sample("_sample_exponential", out=out, lam=lam, shape=shape or (1,))
+
+
+def poisson(lam=1, shape=None, ctx=None, out=None):
+    return _sample("_sample_poisson", out=out, lam=lam, shape=shape or (1,))
+
+
+def negative_binomial(k=1, p=1, shape=None, ctx=None, out=None):
+    return _sample("_sample_negbinomial", out=out, k=k, p=p,
+                   shape=shape or (1,))

@@ -1,0 +1,201 @@
+"""RecordIO: packed record format + indexed reader
+(ref: python/mxnet/recordio.py, 375 LoC; C++ format at
+dmlc-core recordio + src/io/image_recordio.h IRHeader).
+
+Format parity: the dmlc RecordIO framing (magic 0xced7230a, length-or-marker
+word, 4-byte alignment) and the image IRHeader (flag, label, id, id2) are
+reproduced so datasets packed by either side are readable. A C++ reader with
+multithreaded decode is the SURVEY §7 stage-8 follow-up; this module is the
+format/API layer.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xced7230a
+_KMAGIC_STRUCT = struct.Struct("<I")
+_LREC_STRUCT = struct.Struct("<I")
+
+# IRHeader (ref: src/io/image_recordio.h:25-60)
+IRHeader_FMT = "<IfQQ"
+IRHeader_SIZE = struct.calcsize(IRHeader_FMT)
+
+
+class IRHeader(object):
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag=0, label=0.0, id=0, id2=0):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(rec):
+    return (rec >> 29) & 7, rec & ((1 << 29) - 1)
+
+
+class MXRecordIO(object):
+    """Sequential RecordIO reader/writer (ref: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self.handle.write(_KMAGIC_STRUCT.pack(_MAGIC))
+        self.handle.write(_LREC_STRUCT.pack(_encode_lrec(0, len(buf))))
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(4)
+        if len(head) < 4:
+            return None
+        (magic,) = _KMAGIC_STRUCT.unpack(head)
+        if magic != _MAGIC:
+            raise MXNetError("invalid RecordIO magic")
+        (lrec,) = _LREC_STRUCT.unpack(self.handle.read(4))
+        _cflag, length = _decode_lrec(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO with .idx sidecar (ref: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.is_open:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+def pack(header, s):
+    """Pack a string with IRHeader (ref: recordio.py pack)."""
+    if not isinstance(header, IRHeader):
+        header = IRHeader(*header)
+    buf = struct.pack(IRHeader_FMT, header.flag, header.label, header.id,
+                      header.id2)
+    return buf + s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload) (ref: recordio.py unpack)."""
+    h = IRHeader(*struct.unpack(IRHeader_FMT, s[:IRHeader_SIZE]))
+    payload = s[IRHeader_SIZE:]
+    if h.flag > 0:
+        # multi-label stored after the header (ref: recordio.py)
+        label = np.frombuffer(payload[:h.flag * 4], dtype=np.float32)
+        h2 = IRHeader(h.flag, label, h.id, h.id2)
+        return h2, payload[h.flag * 4:]
+    return h, payload
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """JPEG/PNG-encode and pack (ref: recordio.py pack_img). Uses PIL if
+    available; raises otherwise (OpenCV not in the TPU image)."""
+    try:
+        from PIL import Image
+        import io as _io
+    except ImportError:
+        raise MXNetError("pack_img requires Pillow")
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG" if img_fmt in (".jpg", ".jpeg")
+                              else "PNG", quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack to (IRHeader, image ndarray) (ref: recordio.py unpack_img)."""
+    h, img_bytes = unpack(s)
+    try:
+        from PIL import Image
+        import io as _io
+    except ImportError:
+        raise MXNetError("unpack_img requires Pillow")
+    img = np.asarray(Image.open(_io.BytesIO(img_bytes)))
+    return h, img

@@ -1,0 +1,1 @@
+"""RNN toolkit (ref: python/mxnet/rnn/ — cells, bucketing IO, checkpoints)."""

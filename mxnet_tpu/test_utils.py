@@ -1,0 +1,312 @@
+"""Testing utilities (ref: python/mxnet/test_utils.py, 905 LoC).
+
+The op-correctness backbone matches the reference strategy (SURVEY.md §4):
+finite-difference numeric gradient checking (ref: test_utils.py:360
+check_numeric_gradient), symbolic forward/backward comparators, and
+cross-device consistency checks.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array, zeros
+from . import ndarray as nd
+from .symbol import Symbol
+from . import random as _random
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    Context._default_ctx.value = ctx
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    rng = _random.np_rng()
+    return (int(rng.integers(1, dim0 + 1)), int(rng.integers(1, dim1 + 1)))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    rng = _random.np_rng()
+    return tuple(int(rng.integers(1, d + 1)) for d in (dim0, dim1, dim2))
+
+
+def rand_ndarray(shape, ctx=None, scale=1.0):
+    rng = _random.np_rng()
+    return array(rng.uniform(-scale, scale, shape).astype(np.float32), ctx=ctx)
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Apply a numpy reduce over possibly-tuple axis (ref: test_utils.py)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def reldiff(a, b):
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
+    if diff == 0:
+        return 0
+    return diff / norm
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    return np.allclose(a, b, rtol=rtol or 1e-5, atol=atol or 1e-20)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    if isinstance(a, NDArray):
+        a = a.asnumpy()
+    if isinstance(b, NDArray):
+        b = b.asnumpy()
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        index, rel = _find_max_violation(np.asarray(a), np.asarray(b), rtol, atol)
+        raise AssertionError(
+            "Items are not equal:\nError %f exceeds tolerance rtol=%f, atol=%f."
+            "  Location of maximum error:%s, %s=%f, %s=%f"
+            % (rel, rtol, atol, str(index), names[0],
+               np.asarray(a)[index], names[1], np.asarray(b)[index]))
+
+
+def _find_max_violation(a, b, rtol, atol):
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    index = np.unravel_index(np.argmax(violation), violation.shape)
+    return index, violation[index]
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Forward a symbol on given numpy inputs, return numpy outputs
+    (ref: test_utils.py simple_forward)."""
+    ctx = ctx or default_context()
+    inputs = {k: array(v) for k, v in inputs.items()}
+    exe = sym.bind(ctx, args=inputs)
+    exe.forward(is_train=is_train)
+    outputs = [o.asnumpy() for o in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(sym, location, ctx):
+    if isinstance(location, dict):
+        if set(location.keys()) != set(sym.list_arguments()):
+            raise ValueError("Symbol arguments and keys of the given location "
+                             "do not match. symbol args:%s, location.keys():%s"
+                             % (str(set(sym.list_arguments())),
+                                str(set(location.keys()))))
+    else:
+        location = {k: v for k, v in zip(sym.list_arguments(), location)}
+    return {k: (array(v, ctx=ctx) if isinstance(v, np.ndarray) else v)
+            for k, v in location.items()}
+
+
+def _parse_aux_states(sym, aux_states, ctx):
+    if aux_states is None:
+        return {n: zeros(1) for n in []} if not sym.list_auxiliary_states() else None
+    if isinstance(aux_states, dict):
+        pass
+    else:
+        aux_states = {k: v for k, v in zip(sym.list_auxiliary_states(),
+                                           aux_states)}
+    return {k: (array(v, ctx=ctx) if isinstance(v, np.ndarray) else v)
+            for k, v in aux_states.items()}
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Class-central finite differencing (ref: test_utils.py numeric_grad):
+    d(sum(outputs))/d(input) via central differences."""
+    def as_dict():
+        return {k: v.asnumpy() for k, v in location.items()}
+
+    approx_grads = {k: np.zeros(v.shape, dtype=np.float32)
+                    for k, v in location.items()}
+
+    for k, v in location.items():
+        old_value = v.asnumpy()
+        flat = old_value.reshape(-1)
+        grad_flat = approx_grads[k].reshape(-1)
+        for i in range(flat.size):
+            fplus = flat.copy()
+            fplus[i] += eps
+            executor.arg_dict[k][:] = fplus.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_peps = sum(np.sum(o.asnumpy()) for o in executor.outputs)
+            fminus = flat.copy()
+            fminus[i] -= eps
+            executor.arg_dict[k][:] = fminus.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_neps = sum(np.sum(o.asnumpy()) for o in executor.outputs)
+            grad_flat[i] = (f_peps - f_neps) / (2 * eps)
+        executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None):
+    """Verify symbolic backward against finite differences
+    (ref: test_utils.py:360). A random projection head makes the comparison a
+    scalar loss: loss = sum(out * proj)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    aux = _parse_aux_states(sym, aux_states, ctx) if aux_states is not None \
+        else None
+
+    if grad_nodes is None:
+        grad_nodes = [k for k in sym.list_arguments()
+                      if not k.endswith("label")]
+    elif isinstance(grad_nodes, (list, tuple)):
+        grad_nodes = list(grad_nodes)
+    elif isinstance(grad_nodes, dict):
+        grad_nodes = list(grad_nodes.keys())
+
+    input_shape = {k: v.shape for k, v in location.items()}
+    arg_shape, out_shape, aux_shape = sym.infer_shape(**input_shape)
+    proj = [_random.np_rng().normal(0, 1, s).astype(np.float32)
+            for s in out_shape]
+
+    # wrap: loss = sum(sym * proj) via MakeLoss-free plain graph
+    from . import symbol as S
+    heads = list(sym) if len(sym.list_outputs()) > 1 else [sym]
+    grad_req = {k: ("write" if k in grad_nodes else "null")
+                for k in sym.list_arguments()}
+    args_grad = {k: zeros(location[k].shape) for k in grad_nodes}
+    executor = sym.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux)
+    executor.forward(is_train=use_forward_train)
+    executor.backward(out_grads=[array(p) for p in proj])
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    # numeric: central differences of sum(out * proj)
+    eps = numeric_eps
+    numeric_gradients = {}
+    for k in grad_nodes:
+        old_value = location_npy[k]
+        grad = np.zeros(old_value.shape, dtype=np.float32).reshape(-1)
+        flat = old_value.reshape(-1)
+        for i in range(flat.size):
+            for sign, store in ((+1, "p"), (-1, "m")):
+                flat_mod = flat.copy()
+                flat_mod[i] += sign * eps
+                executor.arg_dict[k][:] = flat_mod.reshape(old_value.shape)
+                executor.forward(is_train=use_forward_train)
+                val = sum(np.sum(o.asnumpy() * p)
+                          for o, p in zip(executor.outputs, proj))
+                if sign > 0:
+                    f_p = val
+                else:
+                    f_m = val
+            grad[i] = (f_p - f_m) / (2 * eps)
+        executor.arg_dict[k][:] = old_value
+        numeric_gradients[k] = grad.reshape(old_value.shape)
+
+    for name in grad_nodes:
+        assert_almost_equal(numeric_gradients[name], symbolic_grads[name],
+                            rtol=rtol, atol=atol or 1e-4,
+                            names=("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None):
+    """Compare forward outputs against expected numpy arrays
+    (ref: test_utils.py check_symbolic_forward)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = _parse_aux_states(sym, aux_states, ctx) if aux_states is not None \
+        else None
+    executor = sym.bind(ctx, args=location, aux_states=aux)
+    executor.forward(is_train=False)
+    for output_name, expect, output in zip(sym.list_outputs(), expected,
+                                           executor.outputs):
+        assert_almost_equal(expect, output.asnumpy(), rtol=rtol,
+                            atol=atol or 1e-20,
+                            names=("EXPECTED_%s" % output_name,
+                                   "FORWARD_%s" % output_name))
+    return executor.outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Compare backward gradients against expected numpy arrays
+    (ref: test_utils.py check_symbolic_backward)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = _parse_aux_states(sym, aux_states, ctx) if aux_states is not None \
+        else None
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym.list_arguments(), expected)}
+    args_grad = {k: zeros(v.shape) for k, v in location.items()}
+    executor = sym.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux)
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (list, tuple)):
+        out_grads = [array(v) if isinstance(v, np.ndarray) else v
+                     for v in out_grads]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()}
+    for name in expected:
+        assert_almost_equal(expected[name], grads[name], rtol=rtol,
+                            atol=atol or 1e-20,
+                            names=("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+    return executor.grad_arrays
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None):
+    """Same graph on multiple contexts/dtypes must agree
+    (ref: test_utils.py:676 check_consistency)."""
+    if tol is None:
+        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+               np.dtype(np.float64): 1e-5}
+    assert len(ctx_list) > 1
+    if isinstance(sym, Symbol):
+        sym = [sym] * len(ctx_list)
+    else:
+        assert len(sym) == len(ctx_list)
+    output_points = None
+    results = []
+    rng = _random.np_rng()
+    arg_np = None
+    for s, ctx in zip(sym, ctx_list):
+        ctx_spec = dict(ctx)
+        context = ctx_spec.pop("ctx")
+        dtype = np.dtype(ctx_spec.pop("type_dict", {}).get("data", np.float32))
+        exe = s.simple_bind(context, grad_req=grad_req, **ctx_spec)
+        if arg_np is None:
+            arg_np = {k: rng.normal(0, scale, v.shape).astype(np.float32)
+                      for k, v in exe.arg_dict.items()}
+        for k, v in exe.arg_dict.items():
+            v[:] = arg_np[k].astype(dtype)
+        exe.forward(is_train=False)
+        results.append([o.asnumpy().astype(np.float32) for o in exe.outputs])
+    for res in results[1:]:
+        for r0, r in zip(results[0], res):
+            assert_almost_equal(r0, r, rtol=tol[np.dtype(np.float32)],
+                                atol=1e-3)
+    return results

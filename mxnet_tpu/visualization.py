@@ -1,0 +1,114 @@
+"""Network visualization (ref: python/mxnet/visualization.py, 328 LoC):
+print_summary and plot_network (graphviz, optional)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from .symbol import Symbol
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Tabular per-layer summary with params/shape (ref: visualization.py)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape_partial(**shape)
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "null":
+            cur_param = 0
+        else:
+            key = node["name"] + "_output"
+            shape_key = shape_dict.get(key)
+        if show_shape:
+            key = node["name"] + ("_output" if op != "null" else "")
+            out_shape = shape_dict.get(key, "")
+        name = node["name"]
+        print_row(["%s(%s)" % (name, op), str(out_shape) if out_shape else "",
+                   cur_param, ",".join(pre_node)], positions)
+        total_params[0] += cur_param
+
+    heads = set(h[0] for h in conf["heads"])
+    for node in nodes:
+        print_layer_summary(node, "")
+        print("_" * line_length)
+    print("Total params: %s" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs={}, hide_weights=True):
+    """Graphviz rendering of the DAG (ref: visualization.py plot_network).
+    Requires the optional graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires graphviz (optional dep)")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and (name.endswith("_weight")
+                                 or name.endswith("_bias")
+                                 or name.endswith("_gamma")
+                                 or name.endswith("_beta")):
+                hidden_nodes.add(i)
+                continue
+            dot.node(name=name, label=name, fillcolor="#8dd3c7")
+        else:
+            dot.node(name=name, label="%s\n%s" % (op, name),
+                     fillcolor="#fb8072" if "Output" in op or op == "MakeLoss"
+                     else "#80b1d3")
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for item in node["inputs"]:
+            if item[0] in hidden_nodes:
+                continue
+            dot.edge(tail_name=nodes[item[0]]["name"], head_name=node["name"])
+    return dot

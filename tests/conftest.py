@@ -1,0 +1,17 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-device-without-hardware strategy (SURVEY.md §4:
+cpu(0)/cpu(1) contexts, faked device lists) using
+--xla_force_host_platform_device_count=8. The axon sitecustomize pins
+JAX_PLATFORMS=axon, so the platform is forced back to cpu via jax.config
+before any device is touched.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,71 @@
+"""Imperative autograd tests (ref strategy:
+tests/python/unittest/test_autograd.py over contrib/autograd.py API)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd as ag
+
+
+def test_mark_and_compute_gradient():
+    x = nd.array(np.array([1.0, 2.0, 3.0]))
+    gx = nd.zeros((3,))
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = x * x + 2 * x
+    ag.compute_gradient([y])
+    assert np.allclose(gx.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_grad_and_loss_decorator():
+    @ag.grad_and_loss
+    def f(a, b):
+        return a * b
+
+    an = np.array([1.0, 2.0], np.float32)
+    bn = np.array([3.0, 4.0], np.float32)
+    grads, loss = f(nd.array(an), nd.array(bn))
+    assert np.allclose(grads[0].asnumpy(), bn)
+    assert np.allclose(grads[1].asnumpy(), an)
+    assert np.allclose(loss.asnumpy(), an * bn)
+
+
+def test_grad_req_add():
+    x = nd.array(np.array([2.0]))
+    gx = nd.array(np.array([10.0]))
+    ag.mark_variables([x], [gx], grad_reqs="add")
+    with ag.train_section():
+        y = x * 3
+    ag.compute_gradient([y])
+    assert np.allclose(gx.asnumpy(), 13.0)
+
+
+def test_training_mode_dropout():
+    x = nd.ones((50, 50))
+    with ag.train_section():
+        y = mx.nd.Dropout(x, p=0.5)
+        assert (y.asnumpy() == 0).any()
+    with ag.test_section():
+        y = mx.nd.Dropout(x, p=0.5)
+        assert not (y.asnumpy() == 0).any()
+
+
+def test_chained_ops_gradient():
+    x = nd.array(np.array([0.5, 1.5]))
+    gx = nd.zeros((2,))
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = nd.exp(x)
+        z = y * y
+    ag.compute_gradient([z])
+    # d(exp(x)^2)/dx = 2 exp(2x)
+    assert np.allclose(gx.asnumpy(), 2 * np.exp(2 * x.asnumpy()), rtol=1e-4)
+
+
+def test_out_grads():
+    x = nd.array(np.array([1.0, 2.0]))
+    gx = nd.zeros((2,))
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = x * 4
+    ag.compute_gradient([y], out_grads=[nd.array(np.array([1.0, 0.5]))])
+    assert np.allclose(gx.asnumpy(), [4.0, 2.0])

@@ -1,0 +1,140 @@
+"""Executor tests (ref strategy: tests/python/unittest/test_executor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def test_bind_forward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    ex = c.bind(mx.cpu(), {"a": nd.ones((3,)), "b": nd.ones((3,)) * 2})
+    ex.forward()
+    assert (ex.outputs[0].asnumpy() == 3).all()
+
+
+def test_backward_grads():
+    # y = sum-ish via head grad: dy/da = b, dy/db = a for y = a*b
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    y = a * b
+    an = np.random.rand(4).astype(np.float32)
+    bn = np.random.rand(4).astype(np.float32)
+    ag = nd.zeros((4,))
+    bg = nd.zeros((4,))
+    ex = y.bind(mx.cpu(), {"a": nd.array(an), "b": nd.array(bn)},
+                args_grad={"a": ag, "b": bg})
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((4,)))
+    assert np.allclose(ag.asnumpy(), bn, rtol=1e-5)
+    assert np.allclose(bg.asnumpy(), an, rtol=1e-5)
+
+
+def test_grad_req_add():
+    a = sym.Variable("a")
+    y = a * 2
+    ag = nd.ones((3,))  # pre-existing gradient content
+    ex = y.bind(mx.cpu(), {"a": nd.ones((3,))}, args_grad={"a": ag},
+                grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((3,)))
+    assert np.allclose(ag.asnumpy(), 1 + 2)  # accumulated
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((3,)))
+    assert np.allclose(ag.asnumpy(), 3 + 2)
+
+
+def test_grad_req_null():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    y = a * b
+    ag = nd.zeros((2,))
+    ex = y.bind(mx.cpu(), {"a": nd.ones((2,)), "b": nd.ones((2,))},
+                args_grad={"a": ag}, grad_req={"a": "write", "b": "null"})
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((2,)))
+    assert np.allclose(ag.asnumpy(), 1)
+
+
+def test_outputs_after_backward_single_pass():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    out = sym.SoftmaxOutput(data=fc, name="softmax")
+    ex = out.simple_bind(mx.cpu(), data=(4, 5), softmax_label=(4,))
+    ex.arg_dict["data"][:] = np.random.rand(4, 5)
+    ex.arg_dict["fc_weight"][:] = np.random.rand(3, 5) * 0.1
+    ex.forward(is_train=True)
+    ex.backward()
+    out_np = ex.outputs[0].asnumpy()
+    assert np.allclose(out_np.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_aux_state_update():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn", momentum=0.5)
+    ex = bn.simple_bind(mx.cpu(), data=(8, 3))
+    x = np.random.rand(8, 3).astype(np.float32) * 10
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = 1
+    mean_before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    _ = ex.outputs[0].asnumpy()
+    mean_after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(mean_before, mean_after)  # moving stats updated
+    # eval mode must NOT update aux
+    mean2 = mean_after.copy()
+    ex.forward(is_train=False)
+    _ = ex.outputs[0].asnumpy()
+    assert np.allclose(mean2, ex.aux_dict["bn_moving_mean"].asnumpy())
+
+
+def test_copy_params_and_reshape():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    ex = fc.simple_bind(mx.cpu(), data=(4, 5))
+    w = np.random.rand(3, 5).astype(np.float32)
+    ex.copy_params_from({"fc_weight": nd.array(w)}, allow_extra_params=True)
+    assert np.allclose(ex.arg_dict["fc_weight"].asnumpy(), w)
+    ex2 = ex.reshape(data=(8, 5))
+    assert ex2.arg_dict["data"].shape == (8, 5)
+    # weights shared
+    assert np.allclose(ex2.arg_dict["fc_weight"].asnumpy(), w)
+
+
+def test_monitor_callback():
+    seen = []
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=2, name="fc")
+    ex = fc.simple_bind(mx.cpu(), data=(2, 3))
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward()
+    assert "fc_output" in seen
+
+
+def test_shared_grad_buffer_accumulates():
+    """Weight tying: one grad buffer bound to two args receives the SUM."""
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    y = a * 2 + b * 3
+    g = nd.zeros((2,))
+    ex = y.bind(mx.cpu(), {"a": nd.ones((2,)), "b": nd.ones((2,))},
+                args_grad={"a": g, "b": g}, grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((2,)))
+    assert np.allclose(g.asnumpy(), 5.0)  # 2 + 3
+    # and with add req
+    ex2 = y.bind(mx.cpu(), {"a": nd.ones((2,)), "b": nd.ones((2,))},
+                 args_grad={"a": g, "b": g}, grad_req="add")
+    ex2.forward(is_train=True)
+    ex2.backward(out_grads=nd.ones((2,)))
+    assert np.allclose(g.asnumpy(), 10.0)  # 5 (prev) + 5
+
+
+def test_forward_returns_lazy_outputs():
+    a = sym.Variable("a")
+    y = a * 2
+    ex = y.bind(mx.cpu(), {"a": nd.ones((3,))})
+    outs = ex.forward(is_train=True)
+    assert len(outs) == 1
+    assert np.allclose(outs[0].asnumpy(), 2.0)

@@ -1,0 +1,84 @@
+"""Data iterator tests (ref strategy: tests/python/unittest/test_io.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+
+def test_ndarray_iter_basic():
+    X = np.arange(40).reshape(10, 4).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = io.NDArrayIter(X, y, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert (batches[0].data[0].asnumpy() == X[:5]).all()
+    assert (batches[0].label[0].asnumpy() == y[:5]).all()
+    assert batches[0].pad == 0
+
+
+def test_ndarray_iter_pad():
+    X = np.arange(28).reshape(7, 4).astype(np.float32)
+    it = io.NDArrayIter(X, None, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    # padded with wrap-around
+    assert (batches[-1].data[0].asnumpy()[1:] == X[:2]).all()
+
+
+def test_ndarray_iter_discard():
+    X = np.arange(28).reshape(7, 4).astype(np.float32)
+    it = io.NDArrayIter(X, None, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_reset():
+    X = np.arange(12).reshape(6, 2).astype(np.float32)
+    it = io.NDArrayIter(X, None, batch_size=3)
+    n1 = len(list(it))
+    it.reset()
+    n2 = len(list(it))
+    assert n1 == n2 == 2
+
+
+def test_provide_data_desc():
+    X = np.zeros((8, 3, 4, 4), np.float32)
+    y = np.zeros(8, np.float32)
+    it = io.NDArrayIter(X, y, batch_size=2)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (2, 3, 4, 4)
+    assert it.provide_label[0].name == "softmax_label"
+    assert it.provide_label[0].shape == (2,)
+
+
+def test_resize_iter():
+    X = np.zeros((6, 2), np.float32)
+    it = io.ResizeIter(io.NDArrayIter(X, None, batch_size=2), size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    X = np.arange(24).reshape(12, 2).astype(np.float32)
+    y = np.arange(12).astype(np.float32)
+    inner = io.NDArrayIter(X, y, batch_size=4)
+    it = io.PrefetchingIter(inner)
+    batches = list(it)
+    assert len(batches) == 3
+    assert (batches[0].data[0].asnumpy() == X[:4]).all()
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    X = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    np.savetxt(data_path, X, delimiter=",")
+    np.savetxt(label_path, y, delimiter=",")
+    it = io.CSVIter(data_csv=data_path, data_shape=(3,),
+                    label_csv=label_path, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert np.allclose(batches[0].data[0].asnumpy(), X[:5], rtol=1e-5)

@@ -1,0 +1,82 @@
+"""KVStore semantics (ref strategy: tests/python/unittest/test_kvstore.py —
+aggregation over fake device lists)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import kvstore as kvs
+
+
+def test_init_pull():
+    kv = kvs.create("local")
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert (out.asnumpy() == 1).all()
+
+
+def test_push_aggregation():
+    kv = kvs.create("local")
+    kv.init(3, nd.zeros((2, 3)))
+    # push a list standing in for 4 devices
+    kv.push(3, [nd.ones((2, 3)) for _ in range(4)])
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert (out.asnumpy() == 4).all()
+
+
+def test_updater():
+    kv = kvs.create("local")
+    kv.init("w", nd.zeros((2,)))
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+    kv._set_updater(updater)
+    kv.push("w", nd.ones((2,)))
+    out = nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 2).all()
+
+
+def test_list_keys():
+    kv = kvs.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [nd.ones((2,))] * 3)
+    kv.push(keys, [[nd.ones((2,))] * 2] * 3)  # 2 fake devices per key
+    outs = [nd.zeros((2,)) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        assert (o.asnumpy() == 2).all()  # replaced by the 2-device reduce
+
+
+def test_set_optimizer_bsp_closed_form():
+    """BSP semantics closed form (ref: tests/nightly/dist_sync_kvstore.py:
+    with Test optimizer w += rescale*grad, after nrepeat pushes of ones*rate:
+    w == 1 + rate * nrepeat * ndev)."""
+    kv = kvs.create("local")
+    kv.init(0, nd.ones((4,)))
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=1.0))
+    nrepeat, ndev, rate = 3, 2, 2.0
+    for _ in range(nrepeat):
+        kv.push(0, [nd.ones((4,)) * rate for _ in range(ndev)])
+    out = nd.zeros((4,))
+    kv.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), 1 + rate * nrepeat * ndev)
+
+
+def test_dist_sync_single_process():
+    kv = kvs.create("dist_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.init(0, nd.zeros((2,)))
+    kv.push(0, nd.ones((2,)))
+    out = nd.zeros((2,))
+    kv.pull(0, out=out)
+    assert (out.asnumpy() == 1).all()
+    kv.barrier()
+
+
+def test_dist_async_rejected():
+    import pytest
+    with pytest.raises(mx.base.NotImplementedForTPU):
+        kvs.create("dist_async")

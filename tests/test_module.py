@@ -1,0 +1,127 @@
+"""Module API tests (ref strategy: tests/python/unittest/test_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def _mlp_sym(num_hidden=16, num_classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _toy_data(n=256, dim=10, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, classes)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return X, y
+
+
+def test_module_fit_convergence():
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=15, optimizer_params={"learning_rate": 0.5})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_forward_outputs():
+    X, y = _toy_data(64)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.array(X[:8])], label=[nd.array(y[:8])])
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert len(outs) == 1 and outs[0].shape == (8, 4)
+    assert np.allclose(outs[0].asnumpy().sum(1), 1.0, rtol=1e-4)
+
+
+def test_module_predict_and_score():
+    X, y = _toy_data(96)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    pred = mod.predict(it)
+    assert pred.shape == (96, 4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _toy_data(64)
+    train = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    p1, _ = mod.get_params()
+    p2, _ = mod2.get_params()
+    for k in p1:
+        assert np.allclose(p1[k].asnumpy(), p2[k].asnumpy()), k
+    # predictions identical
+    o1 = mod.predict(mx.io.NDArrayIter(X, y, batch_size=32)).asnumpy()
+    o2 = mod2.predict(mx.io.NDArrayIter(X, y, batch_size=32)).asnumpy()
+    assert np.allclose(o1, o2, rtol=1e-5)
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.ones((4, 10))],
+                            label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_multi_device_data_parallel():
+    """Multiple cpu contexts: SPMD data parallelism over a virtual mesh
+    (ref strategy: test_module with cpu device lists)."""
+    import jax
+    n = min(4, len(jax.devices()))
+    ctxs = [mx.cpu(i) for i in range(n)]
+    X, y = _toy_data(256)
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=ctxs)
+    mod.fit(train, num_epoch=10, optimizer_params={"learning_rate": 0.5})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.85, score
+
+
+def test_set_get_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    args, auxs = mod.get_params()
+    w = np.random.rand(*args["fc1_weight"].shape).astype(np.float32)
+    args["fc1_weight"] = nd.array(w)
+    mod.set_params(args, auxs)
+    args2, _ = mod.get_params()
+    assert np.allclose(args2["fc1_weight"].asnumpy(), w)
+
+
+def test_feedforward_api():
+    X, y = _toy_data(128)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=25,
+                                 numpy_batch_size=32, learning_rate=0.5)
+    model.fit(X, y)
+    pred = model.predict(X)
+    acc = (pred.argmax(1) == y).mean()
+    assert acc > 0.8

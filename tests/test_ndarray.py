@@ -1,0 +1,151 @@
+"""NDArray tests (ref strategy: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.asnumpy().sum() == 0
+    b = nd.ones((2, 2), dtype=np.float32)
+    assert b.asnumpy().sum() == 4
+    c = nd.full((2, 2), 7)
+    assert (c.asnumpy() == 7).all()
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert (e.asnumpy() == np.arange(0, 10, 2)).all()
+
+
+def test_arithmetic():
+    a = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    b = nd.array(np.array([[5.0, 6.0], [7.0, 8.0]]))
+    assert ((a + b).asnumpy() == np.array([[6, 8], [10, 12]])).all()
+    assert ((b - a).asnumpy() == 4).all()
+    assert ((a * 2).asnumpy() == np.array([[2, 4], [6, 8]])).all()
+    assert ((2 * a).asnumpy() == (a * 2).asnumpy()).all()
+    assert np.allclose((1.0 / a).asnumpy(), 1.0 / a.asnumpy())
+    assert np.allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert ((-a).asnumpy() == -a.asnumpy()).all()
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a /= 2
+    assert (a.asnumpy() == 3).all()
+
+
+def test_slicing_and_writeback():
+    a = nd.zeros((4, 3))
+    a[1] = 1.0
+    assert a.asnumpy()[1].sum() == 3
+    a[2:4] = 2.0
+    assert (a.asnumpy()[2:4] == 2).all()
+    s = a[0:2]
+    s[:] = 5.0
+    assert (a.asnumpy()[0:2] == 5).all()  # view write-back
+
+
+def test_setitem_array():
+    a = nd.zeros((3, 2))
+    a[1] = np.array([7.0, 8.0])
+    assert (a.asnumpy()[1] == [7, 8]).all()
+
+
+def test_copyto_and_context():
+    a = nd.ones((2, 2))
+    b = nd.zeros((2, 2))
+    a.copyto(b)
+    assert (b.asnumpy() == 1).all()
+    c = a.copyto(mx.cpu())
+    assert (c.asnumpy() == 1).all()
+    assert a.context.device_type in ("cpu", "tpu")
+
+
+def test_reshape_transpose():
+    a = nd.arange(6).reshape((2, 3))
+    assert a.shape == (2, 3)
+    assert a.T.shape == (3, 2)
+    assert (a.T.asnumpy() == a.asnumpy().T).all()
+
+
+def test_reductions_and_ops():
+    x = np.random.rand(3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert np.allclose(nd.sum(a).asnumpy(), x.sum(), rtol=1e-5)
+    assert np.allclose(nd.max(a, axis=1).asnumpy(), x.max(1), rtol=1e-5)
+    assert np.allclose(nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert np.allclose(nd.dot(a, nd.array(x.T)).asnumpy(), x @ x.T, rtol=1e-4)
+    assert np.allclose(nd.clip(a, a_min=0.2, a_max=0.8).asnumpy(),
+                       np.clip(x, 0.2, 0.8))
+
+
+def test_broadcast():
+    a = nd.array(np.random.rand(3, 1).astype(np.float32))
+    b = nd.array(np.random.rand(1, 4).astype(np.float32))
+    c = nd.broadcast_add(a, b)
+    assert c.shape == (3, 4)
+    assert np.allclose(c.asnumpy(), a.asnumpy() + b.asnumpy())
+    d = a.broadcast_to((3, 5))
+    assert d.shape == (3, 5)
+
+
+def test_comparison():
+    a = nd.array(np.array([1.0, 2.0, 3.0]))
+    b = nd.array(np.array([2.0, 2.0, 2.0]))
+    assert ((a > b).asnumpy() == [0, 0, 1]).all()
+    assert ((a == b).asnumpy() == [0, 1, 0]).all()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.params")
+    a = nd.ones((2, 3))
+    b = nd.zeros((1, 4))
+    nd.save(fname, [a, b])
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert (loaded[0].asnumpy() == 1).all()
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert isinstance(loaded, dict)
+    assert (loaded["a"].asnumpy() == 1).all()
+
+
+def test_onehot():
+    idx = nd.array(np.array([0.0, 2.0]))
+    out = nd.zeros((2, 3))
+    nd.onehot_encode(idx, out)
+    assert (out.asnumpy() == [[1, 0, 0], [0, 0, 1]]).all()
+
+
+def test_add_n():
+    arrs = [nd.ones((2, 2)) for _ in range(4)]
+    s = nd.add_n(*arrs)
+    assert (s.asnumpy() == 4).all()
+
+
+def test_asscalar():
+    a = nd.array(np.array([3.5]))
+    assert a.asscalar() == pytest.approx(3.5)
+
+
+def test_waitall():
+    nd.waitall()
+
+
+def test_imperative_batchnorm_with_aux():
+    """Imperative aux-state op: trailing positionals are aux states."""
+    x = nd.array(np.random.rand(8, 3).astype(np.float32) * 4)
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mmean, mvar = nd.zeros((3,)), nd.ones((3,))
+    out = mx.nd.BatchNorm(x, gamma, beta, mmean, mvar, fix_gamma=False,
+                          momentum=0.5)
+    # eval mode: normalized by moving stats (mean 0 var 1) => out == x
+    assert np.allclose(out.asnumpy(), x.asnumpy(), atol=1e-2)

@@ -1,0 +1,286 @@
+"""Operator correctness (ref strategy: tests/python/unittest/test_operator.py:
+numpy forward references + finite-difference gradient checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.test_utils import (check_numeric_gradient,
+                                  check_symbolic_forward, assert_almost_equal)
+
+
+def test_elementwise_forward():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    for name, ref in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                      ("abs", np.abs), ("square", np.square),
+                      ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                      ("tanh", np.tanh)]:
+        data = sym.Variable("data")
+        s = getattr(sym, name)(data=data)
+        check_symbolic_forward(s, {"data": x}, [ref(x)], rtol=1e-4, atol=1e-5)
+
+
+def test_fully_connected_numeric_grad():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    x = np.random.rand(2, 4).astype(np.float32)
+    w = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           numeric_eps=1e-2, rtol=1e-1, atol=1e-2)
+
+
+def test_convolution_forward():
+    # conv vs explicit numpy loop
+    x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    w = np.random.rand(2, 1, 3, 3).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, kernel=(3, 3), num_filter=2, name="c")
+    expected = np.zeros((1, 2, 3, 3), np.float32)
+    for f in range(2):
+        for i in range(3):
+            for j in range(3):
+                expected[0, f, i, j] = np.sum(x[0, 0, i:i+3, j:j+3] * w[f, 0])
+    check_symbolic_forward(conv, {"data": x, "c_weight": w, "c_bias": b},
+                           [expected], rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_numeric_grad():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, kernel=(2, 2), num_filter=2, name="c",
+                           no_bias=True)
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    w = np.random.rand(2, 1, 2, 2).astype(np.float32)
+    check_numeric_gradient(conv, {"data": x, "c_weight": w},
+                           numeric_eps=1e-2, rtol=1e-1, atol=1e-2)
+
+
+def test_pooling():
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    data = sym.Variable("data")
+    pmax = sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    expected = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    check_symbolic_forward(pmax, {"data": x}, [expected], rtol=1e-5, atol=1e-6)
+    pavg = sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                       pool_type="avg")
+    expected = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_symbolic_forward(pavg, {"data": x}, [expected], rtol=1e-5, atol=1e-6)
+
+
+def test_global_pooling():
+    x = np.random.rand(2, 3, 4, 5).astype(np.float32)
+    data = sym.Variable("data")
+    p = sym.Pooling(data=data, kernel=(1, 1), global_pool=True,
+                    pool_type="avg")
+    check_symbolic_forward(p, {"data": x},
+                           [x.mean(axis=(2, 3), keepdims=True)],
+                           rtol=1e-5, atol=1e-6)
+
+
+def test_activation_grads():
+    x = np.random.rand(3, 4).astype(np.float32) - 0.5
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        data = sym.Variable("data")
+        s = sym.Activation(data=data, act_type=act)
+        check_numeric_gradient(s, {"data": x}, numeric_eps=1e-3, rtol=1e-1,
+                               atol=1e-2)
+
+
+def test_leaky_relu():
+    x = np.array([[-1.0, 2.0], [-3.0, 4.0]], np.float32)
+    data = sym.Variable("data")
+    s = sym.LeakyReLU(data=data, act_type="leaky", slope=0.1)
+    check_symbolic_forward(s, {"data": x}, [np.where(x > 0, x, 0.1 * x)],
+                           rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_train_stats():
+    x = np.random.rand(8, 3, 2, 2).astype(np.float32) * 5
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn", fix_gamma=False, eps=1e-5)
+    ex = bn.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = 1
+    ex.arg_dict["bn_beta"][:] = 0
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    # per-channel normalized
+    assert np.allclose(out.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+    assert np.allclose(out.var(axis=(0, 2, 3)), 1, atol=1e-2)
+
+
+def test_softmax_output_grad():
+    # backward produces softmax - onehot
+    x = np.random.rand(4, 3).astype(np.float32)
+    label = np.array([0.0, 1.0, 2.0, 1.0], np.float32)
+    data = sym.Variable("data")
+    s = sym.SoftmaxOutput(data=data, name="sm")
+    ag = nd.zeros((4, 3))
+    ex = s.bind(mx.cpu(), {"data": nd.array(x), "sm_label": nd.array(label)},
+                args_grad={"data": ag},
+                grad_req={"data": "write", "sm_label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    sm = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    oh = np.eye(3, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(ag.asnumpy(), sm - oh, rtol=1e-4, atol=1e-5)
+
+
+def test_linear_regression_grad():
+    x = np.random.rand(4, 2).astype(np.float32)
+    label = np.random.rand(4, 2).astype(np.float32)
+    data = sym.Variable("data")
+    s = sym.LinearRegressionOutput(data=data, name="lro")
+    ag = nd.zeros((4, 2))
+    ex = s.bind(mx.cpu(), {"data": nd.array(x), "lro_label": nd.array(label)},
+                args_grad={"data": ag},
+                grad_req={"data": "write", "lro_label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(ag.asnumpy(), x - label, rtol=1e-5, atol=1e-6)
+
+
+def test_block_grad():
+    a = sym.Variable("a")
+    blocked = sym.BlockGrad(data=a * 2) + a
+    ag = nd.zeros((3,))
+    ex = blocked.bind(mx.cpu(), {"a": nd.ones((3,))}, args_grad={"a": ag})
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((3,)))
+    assert np.allclose(ag.asnumpy(), 1.0)  # only the unblocked path
+
+
+def test_concat_slice_channel():
+    xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(3)]
+    syms = [sym.Variable("x%d" % i) for i in range(3)]
+    cat = sym.Concat(*syms, dim=1)
+    ex = cat.bind(mx.cpu(), {("x%d" % i): nd.array(x)
+                             for i, x in enumerate(xs)})
+    ex.forward()
+    assert np.allclose(ex.outputs[0].asnumpy(), np.concatenate(xs, axis=1))
+
+    data = sym.Variable("data")
+    sc = sym.SliceChannel(data=data, num_outputs=3, axis=1)
+    ex = sc.bind(mx.cpu(), {"data": nd.array(np.concatenate(xs, axis=1))})
+    ex.forward()
+    for o, x in zip(ex.outputs, xs):
+        assert np.allclose(o.asnumpy(), x)
+
+
+def test_reshape_special_codes():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    r = sym.Reshape(data=data, shape=(0, -1))
+    check_symbolic_forward(r, {"data": x}, [x.reshape(2, 12)], rtol=1e-6)
+    r = sym.Reshape(data=data, shape=(-3, 0))
+    check_symbolic_forward(r, {"data": x}, [x.reshape(6, 4)], rtol=1e-6)
+
+
+def test_transpose_swapaxis():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.transpose(data=data), {"data": x},
+                           [x.T], rtol=1e-6)
+    check_symbolic_forward(sym.SwapAxis(data=data, dim1=0, dim2=2),
+                           {"data": x}, [np.swapaxes(x, 0, 2)], rtol=1e-6)
+
+
+def test_embedding():
+    idx = np.array([[0.0, 2.0], [1.0, 0.0]], np.float32)
+    w = np.random.rand(3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    emb = sym.Embedding(data=data, input_dim=3, output_dim=4, name="emb")
+    check_symbolic_forward(emb, {"data": idx, "emb_weight": w},
+                           [w[idx.astype(int)]], rtol=1e-6)
+
+
+def test_reductions():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.sum(data=data, axis=1), {"data": x},
+                           [x.sum(1)], rtol=1e-5, atol=1e-6)
+    check_symbolic_forward(sym.mean(data=data, axis=(0, 2), keepdims=True),
+                           {"data": x}, [x.mean(axis=(0, 2), keepdims=True)],
+                           rtol=1e-5, atol=1e-6)
+    check_symbolic_forward(sym.argmax(data=data, axis=2), {"data": x},
+                           [x.argmax(2).astype(np.float32)], rtol=1e-6)
+
+
+def test_topk_sort():
+    x = np.random.rand(3, 5).astype(np.float32)
+    data = sym.Variable("data")
+    k = sym.topk(data=data, k=2, ret_typ="value")
+    expected = np.sort(x, axis=1)[:, ::-1][:, :2]
+    check_symbolic_forward(k, {"data": x}, [expected], rtol=1e-6)
+    s = sym.sort(data=data)
+    check_symbolic_forward(s, {"data": x}, [np.sort(x, 1)], rtol=1e-6)
+
+
+def test_where():
+    cond = np.array([1.0, 0.0, 1.0], np.float32)
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    y = np.array([7.0, 8.0, 9.0], np.float32)
+    c, a, b = sym.Variable("c"), sym.Variable("a"), sym.Variable("b")
+    w = sym.where(condition=c, x=a, y=b)
+    ex = w.bind(mx.cpu(), {"c": nd.array(cond), "a": nd.array(x),
+                           "b": nd.array(y)})
+    ex.forward()
+    assert np.allclose(ex.outputs[0].asnumpy(), [1, 8, 3])
+
+
+def test_dropout_train_eval():
+    data = sym.Variable("data")
+    d = sym.Dropout(data=data, p=0.5)
+    x = np.ones((100, 100), np.float32)
+    ex = d.bind(mx.cpu(), {"data": nd.array(x)})
+    ex.forward(is_train=False)
+    assert np.allclose(ex.outputs[0].asnumpy(), x)  # identity in eval
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    frac_zero = (out == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    # kept elements scaled by 1/(1-p)
+    assert np.allclose(out[out != 0], 2.0)
+
+
+def test_sequence_mask():
+    x = np.random.rand(4, 2, 3).astype(np.float32)
+    seq_len = np.array([2.0, 4.0], np.float32)
+    data = sym.Variable("data")
+    sl = sym.Variable("sl")
+    m = sym.SequenceMask(data=data, sequence_length=sl,
+                         use_sequence_length=True, value=-1.0)
+    ex = m.bind(mx.cpu(), {"data": nd.array(x), "sl": nd.array(seq_len)})
+    ex.forward()
+    out = ex.outputs[0].asnumpy()
+    assert np.allclose(out[:2, 0], x[:2, 0])
+    assert np.allclose(out[2:, 0], -1.0)
+    assert np.allclose(out[:, 1], x[:, 1])
+
+
+def test_elemwise_grad_via_numeric():
+    x = np.random.rand(3, 3).astype(np.float32) + 0.1
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    for op in [lambda: a * b + a, lambda: a / (b + 1), lambda: a ** 2 + b]:
+        s = op()
+        check_numeric_gradient(s, {"a": x, "b": x + 0.5}, numeric_eps=1e-3,
+                               rtol=1e-1, atol=1e-2)
+
+
+def test_pooling_numeric_grad():
+    """Regression: reduce_window init must be a literal for JAX's vjp rule.
+
+    Values are spaced 0.1 apart so the finite-difference eps can never flip a
+    max-pool argmax (which would make the numeric gradient ill-defined)."""
+    rng = np.random.default_rng(5)
+    x = rng.permutation(np.arange(32, dtype=np.float32) * 0.1).reshape(
+        1, 2, 4, 4)
+    data = sym.Variable("data")
+    for ptype in ["max", "avg"]:
+        p = sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                        pool_type=ptype)
+        check_numeric_gradient(p, {"data": x}, numeric_eps=1e-2, rtol=1e-1,
+                               atol=1e-2)

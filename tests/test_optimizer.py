@@ -1,0 +1,133 @@
+"""Optimizer tests vs numpy references (ref strategy:
+tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+
+
+def _run_updates(optimizer, w0, grads):
+    w = nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        optimizer.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_no_momentum():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(3)]
+    o = opt.create("sgd", learning_rate=0.1, rescale_grad=1.0, wd=0.0)
+    got = _run_updates(o, w0, grads)
+    expect = w0.copy()
+    for g in grads:
+        expect = expect - 0.1 * g
+    assert np.allclose(got, expect, rtol=1e-5)
+
+
+def test_sgd_momentum_wd():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(4)]
+    lr, mom, wd = 0.1, 0.9, 0.01
+    o = opt.create("sgd", learning_rate=lr, momentum=mom, wd=wd,
+                   rescale_grad=1.0)
+    got = _run_updates(o, w0, grads)
+    expect = w0.copy()
+    m = np.zeros_like(w0)
+    for g in grads:
+        m = mom * m - lr * (g + wd * expect)
+        expect = expect + m
+    assert np.allclose(got, expect, rtol=1e-5)
+
+
+def test_adam():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(3)]
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    o = opt.create("adam", learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps,
+                   rescale_grad=1.0, wd=0.0)
+    got = _run_updates(o, w0, grads)
+    expect = w0.copy()
+    m = np.zeros_like(w0)
+    v = np.zeros_like(w0)
+    for t, g in enumerate(grads, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        expect = expect - lr_t * m / (np.sqrt(v) + eps)
+    assert np.allclose(got, expect, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(3)]
+    lr, g1, eps = 0.01, 0.95, 1e-8
+    o = opt.create("rmsprop", learning_rate=lr, gamma1=g1, epsilon=eps,
+                   rescale_grad=1.0, wd=0.0)
+    got = _run_updates(o, w0, grads)
+    expect = w0.copy()
+    n = np.zeros_like(w0)
+    for g in grads:
+        n = (1 - g1) * g * g + g1 * n
+        expect = expect - lr * g / np.sqrt(n + eps)
+    assert np.allclose(got, expect, rtol=1e-4)
+
+
+def test_adagrad():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(3)]
+    lr, eps = 0.1, 1e-7
+    o = opt.create("adagrad", learning_rate=lr, eps=eps, rescale_grad=1.0,
+                   wd=0.0)
+    got = _run_updates(o, w0, grads)
+    expect = w0.copy()
+    h = np.zeros_like(w0)
+    for g in grads:
+        h += g * g
+        expect = expect - lr * g / np.sqrt(h + eps)
+    assert np.allclose(got, expect, rtol=1e-4)
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, np.float32)
+    g = np.array([10.0, -10.0, 0.5], np.float32)
+    o = opt.create("sgd", learning_rate=1.0, clip_gradient=1.0,
+                   rescale_grad=1.0, wd=0.0)
+    got = _run_updates(o, w0, [g])
+    assert np.allclose(got, [-1.0, 1.0, -0.5], rtol=1e-5)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(5) == 1.0
+    assert s(11) == 0.5
+    m = MultiFactorScheduler(step=[5, 8], factor=0.1)
+    m.base_lr = 1.0
+    assert m(3) == 1.0
+    assert abs(m(6) - 0.1) < 1e-9
+    assert abs(m(9) - 0.01) < 1e-9
+
+
+def test_updater_states_roundtrip():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = nd.ones((4,))
+    u(0, nd.ones((4,)), w)
+    states = u.get_states()
+    u2 = opt.get_updater(opt.create("sgd", learning_rate=0.1, momentum=0.9))
+    u2.set_states(states)
+    assert 0 in u2.states
+
+
+def test_lr_wd_mult_from_attrs():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fcx_weight", lr_mult=0.0)
+    fc = mx.sym.FullyConnected(data=data, weight=w, num_hidden=3, name="fcx")
+    o = opt.create("sgd", learning_rate=1.0, sym=fc,
+                   param_idx2name={0: "fcx_weight"})
+    w0 = np.ones(3, np.float32)
+    got = _run_updates(o, w0, [np.ones(3, np.float32)])
+    assert np.allclose(got, w0)  # lr_mult 0 freezes

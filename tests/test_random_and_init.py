@@ -1,0 +1,102 @@
+"""Random + initializer tests (ref strategy: test_random.py, test_init.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.initializer import (Uniform, Normal, Xavier, Orthogonal,
+                                   Constant, Mixed, Load, InitDesc, One, Zero)
+
+
+def test_seed_determinism():
+    mx.random.seed(42)
+    a = nd.uniform(shape=(5, 5)).asnumpy()
+    mx.random.seed(42)
+    b = nd.uniform(shape=(5, 5)).asnumpy()
+    assert np.allclose(a, b)
+    c = nd.uniform(shape=(5, 5)).asnumpy()
+    assert not np.allclose(b, c)
+
+
+def test_uniform_range():
+    mx.random.seed(0)
+    x = nd.uniform(low=-2, high=2, shape=(1000,)).asnumpy()
+    assert x.min() >= -2 and x.max() <= 2
+    assert abs(x.mean()) < 0.2
+
+
+def test_normal_moments():
+    mx.random.seed(0)
+    x = nd.normal(loc=1.0, scale=2.0, shape=(5000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.15
+    assert abs(x.std() - 2.0) < 0.2
+
+
+def test_initializer_dispatch():
+    init = Xavier()
+    w = nd.zeros((4, 8))
+    init("fc1_weight", w)
+    assert np.abs(w.asnumpy()).sum() > 0
+    b = nd.ones((4,))
+    init("fc1_bias", b)
+    assert (b.asnumpy() == 0).all()
+    g = nd.zeros((4,))
+    init("bn_gamma", g)
+    assert (g.asnumpy() == 1).all()
+    mv = nd.ones((4,))
+    init("bn_moving_mean", mv)
+    assert (mv.asnumpy() == 0).all()
+
+
+def test_uniform_scale():
+    init = Uniform(0.5)
+    w = nd.zeros((100, 10))
+    init("w_weight", w)
+    x = w.asnumpy()
+    assert x.min() >= -0.5 and x.max() <= 0.5
+
+
+def test_orthogonal():
+    init = Orthogonal(scale=1.0)
+    w = nd.zeros((8, 8))
+    init("q_weight", w)
+    q = w.asnumpy()
+    assert np.allclose(q @ q.T, np.eye(8), atol=1e-4)
+
+
+def test_constant_and_mixed():
+    init = Mixed([".*bias", ".*"], [Constant(3), Uniform(0.1)])
+    b = nd.zeros((4,))
+    init("fc_bias", b)
+    assert (b.asnumpy() == 3).all()
+    w = nd.zeros((4, 4))
+    init("fc_weight", w)
+    assert np.abs(w.asnumpy()).max() <= 0.1
+
+
+def test_load_initializer():
+    src = {"fc_weight": nd.ones((2, 2))}
+    init = Load(src, default_init=Zero())
+    w = nd.zeros((2, 2))
+    init("fc_weight", w)
+    assert (w.asnumpy() == 1).all()
+    other = nd.ones((3,))
+    init("other_weight", other)
+    assert (other.asnumpy() == 0).all()
+
+
+def test_init_attr_override():
+    from mxnet_tpu.initializer import Initializer
+    desc = InitDesc("custom_weight", attrs={"__init__": One().dumps()})
+    w = nd.zeros((3, 3))
+    Uniform(0.1)(desc, w)  # __init__ attr overrides to One
+    assert (w.asnumpy() == 1).all()
+
+
+def test_sample_ops():
+    mx.random.seed(7)
+    g = mx.random.gamma(alpha=2.0, beta=1.0, shape=(2000,)).asnumpy()
+    assert g.min() > 0 and abs(g.mean() - 2.0) < 0.3
+    e = mx.random.exponential(lam=2.0, shape=(2000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.1
+    p = mx.random.poisson(lam=3.0, shape=(2000,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.3

@@ -1,0 +1,121 @@
+"""Symbol graph tests (ref strategy: tests/python/unittest/test_symbol.py,
+test_infer_shape.py, test_attr.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_list_arguments():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (10, 100)
+    assert d["fc1_bias"] == (10,)
+    assert d["fc2_weight"] == (4, 10)
+    assert d["softmax_label"] == (32,)
+    assert out_shapes == [(32, 4)]
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, kernel=(3, 3), num_filter=8, pad=(1, 1))
+    pool = sym.Pooling(data=conv, kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    arg_shapes, out_shapes, _ = pool.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d[pool.list_arguments()[1]] == (8, 3, 3, 3)
+    assert out_shapes == [(2, 8, 4, 4)]
+
+
+def test_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    net1 = sym.FullyConnected(data=net1, num_hidden=100, name="fc2")
+    data2 = sym.Variable("data2")
+    net2 = sym.FullyConnected(data=data2, num_hidden=10, name="fc3")
+    composed = net2(data2=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc3_weight" in args
+    assert "data2" not in args
+
+
+def test_group_and_internals():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    fc2 = sym.FullyConnected(data=fc1, num_hidden=4, name="fc2")
+    g = sym.Group([fc1, fc2])
+    assert g.list_outputs() == ["fc1_output", "fc2_output"]
+    internals = fc2.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    sliced = internals["fc1_output"]
+    assert sliced.list_outputs() == ["fc1_output"]
+
+
+def test_multi_output_indexing():
+    data = sym.Variable("data")
+    s = sym.SliceChannel(data=data, num_outputs=3, axis=1, name="sc")
+    assert len(s.list_outputs()) == 3
+    first = s[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # graph still executable
+    ex = net2.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    ex.forward()
+    assert ex.outputs[0].shape == (4, 4)
+
+
+def test_variable_shape_attr():
+    data = mx.sym.Variable("data", shape=(4, 10))
+    fc = sym.FullyConnected(data=data, num_hidden=3)
+    arg_shapes, out_shapes, _ = fc.infer_shape()
+    assert out_shapes == [(4, 3)]
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data=data, num_hidden=3, name="fc_as")
+    assert fc.attr("ctx_group") == "dev1"
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    d = c * 2 - a / b
+    ex = d.bind(mx.cpu(), {"a": mx.nd.array(np.array([4.0])),
+                           "b": mx.nd.array(np.array([2.0]))})
+    ex.forward()
+    assert np.allclose(ex.outputs[0].asnumpy(), [(4 + 2) * 2 - 4 / 2])
+
+
+def test_bn_aux_states():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 4, 4))
+    assert aux_shapes == [(3,), (3,)]
