@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+Mirrors the reference's headline number (BASELINE.md: ResNet-50 train,
+batch 32 — 45.52 img/s K80 / 90.74 M40 / 181.53 P100, from
+docs/how_to/perf.md:159-190; script behavior ref:
+example/image-classification/benchmark_score.py + train_imagenet.py).
+
+vs_baseline is measured against the strongest single-GPU reference number
+(P100, 181.53 img/s). Prints ONE JSON line.
+
+Env knobs: BENCH_BATCH (default 32), BENCH_STEPS (default 20),
+BENCH_DTYPE (float32|bfloat16 compute, default bfloat16),
+BENCH_DEPTH (default 50), BENCH_IMAGE (default 224).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.train_step import TrainStep
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    depth = int(os.environ.get("BENCH_DEPTH", "50"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    baseline = 181.53  # P100, ResNet-50 train b32 (docs/how_to/perf.md:183-190)
+
+    sym = models.resnet(num_classes=1000, num_layers=depth,
+                        image_shape="3,%d,%d" % (image, image))
+    step = TrainStep(sym, optimizer="sgd", learning_rate=0.1, momentum=0.9,
+                     wd=1e-4,
+                     compute_dtype=None if cdtype == "float32" else cdtype)
+    state = step.init({"data": (batch, 3, image, image)},
+                      {"softmax_label": (batch,)})
+
+    rng = np.random.default_rng(0)
+    data = {"data": np.asarray(rng.normal(size=(batch, 3, image, image)),
+                               np.float32),
+            "softmax_label": np.asarray(rng.integers(0, 1000, batch),
+                                        np.float32)}
+    import jax.numpy as jnp
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+
+    # warmup / compile
+    for _ in range(3):
+        state, outs = step.step(state, data)
+    jax.block_until_ready(state["params"]["fc1_weight"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, outs = step.step(state, data)
+    jax.block_until_ready(state["params"]["fc1_weight"])
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet%d_train_images_per_sec_b%d_%s" % (depth, batch,
+                                                            cdtype),
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Train ResNet/Inception/etc. on ImageNet (ref config 2:
+example/image-classification/train_imagenet.py).
+
+Input: RecordIO shards (see tools/im2rec.py) via mxnet_tpu.image.ImageIter,
+or --synthetic for throughput runs. Multi-chip: --gpus 0,1,...  maps to the
+SPMD data-parallel mesh.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+class SyntheticIter(mx.io.DataIter):
+    def __init__(self, batch_size, image_shape, num_classes, epoch_size=50):
+        super().__init__(batch_size)
+        rng = np.random.default_rng(0)
+        self._data = rng.normal(size=(batch_size,) + image_shape).astype(
+            np.float32)
+        self._label = rng.integers(0, num_classes, batch_size).astype(
+            np.float32)
+        self._i = 0
+        self._n = epoch_size
+        self.provide_data = [mx.io.DataDesc(
+            "data", (batch_size,) + image_shape)]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (batch_size,))]
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self._n:
+            raise StopIteration
+        self._i += 1
+        return mx.io.DataBatch([mx.nd.array(self._data)],
+                               [mx.nd.array(self._label)], pad=0)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train imagenet")
+    parser.add_argument("--network", default="resnet")
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--data-train", default=None, help="train .rec path")
+    parser.add_argument("--data-val", default=None)
+    parser.add_argument("--gpus", default=None)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--image-shape", default="3,224,224")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", default="30,60,90")
+    parser.add_argument("--num-epochs", type=int, default=90)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--model-prefix", default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--epoch-size", type=int, default=50)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = models.get_symbol(args.network, num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape=args.image_shape)
+    devs = (mx.current_context() if args.gpus is None
+            else [mx.gpu(int(i)) for i in args.gpus.split(",")])
+
+    if args.synthetic or args.data_train is None:
+        train = SyntheticIter(args.batch_size, image_shape, args.num_classes,
+                              args.epoch_size)
+        val = None
+    else:
+        train = mx.image.ImageIter(
+            batch_size=args.batch_size, data_shape=image_shape,
+            path_imgrec=args.data_train, shuffle=True,
+            aug_list=mx.image.CreateAugmenter(
+                (args.batch_size,) + image_shape, rand_crop=True,
+                rand_mirror=True, mean=True, std=True))
+        val = None if args.data_val is None else mx.image.ImageIter(
+            batch_size=args.batch_size, data_shape=image_shape,
+            path_imgrec=args.data_val,
+            aug_list=mx.image.CreateAugmenter(
+                (args.batch_size,) + image_shape, mean=True, std=True))
+
+    # epoch-boundary lr schedule (ref: fit.py _get_lr_scheduler)
+    epoch_size = args.epoch_size
+    steps = [int(e) * epoch_size for e in args.lr_step_epochs.split(",")]
+    lr_sched = mx.lr_scheduler.MultiFactorScheduler(step=steps, factor=0.1)
+
+    if args.load_epoch is not None and args.model_prefix:
+        mod = mx.mod.Module.load(args.model_prefix, args.load_epoch,
+                                 context=devs)
+        begin_epoch = args.load_epoch
+    else:
+        mod = mx.mod.Module(net, context=devs)
+        begin_epoch = 0
+
+    cb = []
+    if args.model_prefix:
+        cb.append(mx.callback.do_checkpoint(args.model_prefix))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            begin_epoch=begin_epoch,
+            eval_metric=["acc", mx.metric.TopKAccuracy(top_k=5)],
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in", magnitude=2),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": args.wd, "lr_scheduler": lr_sched},
+            kvstore=args.kv_store,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
+            epoch_end_callback=cb)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing (ref config 3:
+example/rnn/lstm_bucketing.py — PTB-style).
+
+Input: a tokenized text file (one sentence per line), or --synthetic.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.rnn import FusedRNNCell, BucketSentenceIter, encode_sentences
+from mxnet_tpu.module import BucketingModule
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", default=None, help="tokenized text file")
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--buckets", default="10,20,30,40,60")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--synthetic", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    invalid_label = 0
+    if args.synthetic or args.data is None:
+        rng = np.random.default_rng(0)
+        vocab_size = 64
+        sentences = []
+        for _ in range(800):
+            L = int(rng.choice(buckets)) - 2
+            s0 = int(rng.integers(1, vocab_size - 1))
+            sentences.append([(s0 + t) % (vocab_size - 1) + 1
+                              for t in range(L)])
+    else:
+        with open(args.data) as f:
+            lines = [line.split() for line in f]
+        sentences, vocab = encode_sentences(lines,
+                                            invalid_label=invalid_label,
+                                            start_label=1)
+        vocab_size = len(vocab) + 1
+
+    it = BucketSentenceIter(sentences, args.batch_size, buckets=buckets,
+                            invalid_label=invalid_label, layout="NT")
+    cell = FusedRNNCell(args.num_hidden, num_layers=args.num_layers,
+                        mode="lstm", prefix="lstm_")
+    LD = args.num_layers  # layers * directions
+    H = args.num_hidden
+    B = args.batch_size
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data=data, input_dim=vocab_size,
+                              output_dim=args.num_embed, name="embed")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = sym.Reshape(data=outputs, shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                  name="pred")
+        label_flat = sym.Reshape(data=label, shape=(-1,))
+        pred = sym.SoftmaxOutput(data=pred, label=label_flat, name="softmax")
+        return pred, ("data", "lstm_begin_state_0", "lstm_begin_state_1"), \
+            ("softmax_label",)
+
+    class StateIter:
+        """Appends zero LSTM begin-states to each batch (the reference
+        provides init_c/init_h the same way, via the iterator)."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.batch_size = inner.batch_size
+            self.default_bucket_key = inner.default_bucket_key
+
+        @property
+        def provide_data(self):
+            return list(self.inner.provide_data) + [
+                ("lstm_begin_state_0", (LD, B, H)),
+                ("lstm_begin_state_1", (LD, B, H))]
+
+        @property
+        def provide_label(self):
+            return self.inner.provide_label
+
+        def reset(self):
+            self.inner.reset()
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            b = next(self.inner)
+            b.data = list(b.data) + [mx.nd.zeros((LD, B, H)),
+                                     mx.nd.zeros((LD, B, H))]
+            b.provide_data = list(b.provide_data) + [
+                ("lstm_begin_state_0", (LD, B, H)),
+                ("lstm_begin_state_1", (LD, B, H))]
+            return b
+
+        next = __next__
+
+    it2 = StateIter(it)
+    mod = BucketingModule(sym_gen, default_bucket_key=it.default_bucket_key,
+                          context=mx.current_context())
+    mod.bind(data_shapes=it2.provide_data, label_shapes=it2.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+    metric = mx.metric.Perplexity(ignore_label=invalid_label)
+    for epoch in range(args.num_epochs):
+        it2.reset()
+        metric.reset()
+        for nbatch, b in enumerate(it2):
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, b.label)
+        logging.info("Epoch[%d] Train-%s=%f", epoch, *metric.get())
+
+
+if __name__ == "__main__":
+    main()

@@ -42,3 +42,6 @@ from . import image
 from . import recordio
 from . import test_utils
 from . import parallel
+from . import models
+from . import train_step
+from .train_step import TrainStep

@@ -397,17 +397,35 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
     type_dict = type_dict or {}
+
+    def _shared(pool, n, sh, dt):
+        # reuse the shared executor's arrays when shape AND dtype match
+        # (ref: shared_exec memory pool, graph_executor.cc:352-355,:505-512 —
+        # bucketing executors share parameter storage)
+        if shared_exec is not None and n in pool \
+                and tuple(pool[n].shape) == tuple(sh) \
+                and pool[n].dtype == dt:
+            return pool[n]
+        return None
+
     args = {}
     grads = {}
     for n, sh in zip(arg_names, arg_shapes):
         dt = np.dtype(type_dict.get(n, np.float32))
-        args[n] = NDArray(jnp.zeros(sh, dt))
+        shared = _shared(shared_exec.arg_dict if shared_exec else {}, n, sh, dt)
+        args[n] = shared if shared is not None else NDArray(jnp.zeros(sh, dt))
         req = grad_req if isinstance(grad_req, str) else (
             grad_req[arg_names.index(n)] if isinstance(grad_req, (list, tuple))
             else grad_req.get(n, "null"))
         if req != "null":
-            grads[n] = NDArray(jnp.zeros(sh, dt))
-    aux = {n: NDArray(jnp.zeros(sh, np.dtype(np.float32)))
-           for n, sh in zip(aux_names, aux_shapes)}
+            sg = _shared(shared_exec.grad_dict if shared_exec else {}, n, sh,
+                         dt)
+            grads[n] = sg if sg is not None else NDArray(jnp.zeros(sh, dt))
+    aux = {}
+    for n, sh in zip(aux_names, aux_shapes):
+        sa = _shared(shared_exec.aux_dict if shared_exec else {}, n, sh,
+                     np.dtype(np.float32))
+        aux[n] = sa if sa is not None else NDArray(
+            jnp.zeros(sh, np.dtype(np.float32)))
     return Executor(symbol, ctx, args, grads or None, grad_req, aux,
                     group2ctx=group2ctx, shared_exec=shared_exec)

@@ -1,0 +1,32 @@
+"""Model zoo: symbol builders for the reference's flagship configs
+(ref: example/image-classification/symbols/*.py, example/rnn).
+
+Each ``get_symbol``-style factory returns a Symbol ready for Module; the
+architectures are the standard published ones (LeCun'98 LeNet, He'15 ResNet,
+Krizhevsky'12 AlexNet, Simonyan'14 VGG, Ioffe'15 Inception-BN), built
+TPU-first: plain graph ops that XLA fuses, bfloat16-ready, no hand layout.
+"""
+from .lenet import get_symbol as lenet
+from .mlp import get_symbol as mlp
+from .resnet import get_symbol as resnet
+from .alexnet import get_symbol as alexnet
+from .vgg import get_symbol as vgg
+from .inception_bn import get_symbol as inception_bn
+
+_FACTORIES = {
+    "lenet": lenet,
+    "mlp": mlp,
+    "resnet": resnet,
+    "alexnet": alexnet,
+    "vgg": vgg,
+    "inception-bn": inception_bn,
+}
+
+
+def get_symbol(network, **kwargs):
+    """Factory by name (ref: example/image-classification/train_*.py
+    --network flag)."""
+    if network not in _FACTORIES:
+        raise ValueError("unknown network %r; have %s"
+                         % (network, sorted(_FACTORIES)))
+    return _FACTORIES[network](**kwargs)

@@ -3,3 +3,5 @@ SURVEY.md §2.6)."""
 from .base_module import BaseModule, BatchEndParam
 from .module import Module
 from .executor_group import DataParallelExecutorGroup
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule

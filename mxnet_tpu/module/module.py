@@ -232,7 +232,10 @@ class Module(BaseModule):
             self.params_initialized = True
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
-            self._exec_group.set_params(self._arg_params, self._aux_params)
+            # parameter buffers are aliased by simple_bind's shared pool —
+            # do NOT set_params here: _arg_params may hold stale host
+            # snapshots from a get_params() sync and would revert training
+            # (ref: module.py shared bind skips parameter copy)
         elif self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 

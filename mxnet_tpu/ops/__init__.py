@@ -4,6 +4,9 @@ from . import registry
 from . import tensor        # noqa: F401  (registers tensor ops)
 from . import nn            # noqa: F401  (registers nn layer ops)
 from . import optimizer_op  # noqa: F401  (registers fused update ops)
+from . import rnn_op        # noqa: F401  (registers the fused RNN op)
+from . import spatial       # noqa: F401  (registers spatial ops)
+from . import contrib       # noqa: F401  (registers contrib/SSD/CTC ops)
 
 get = registry.get
 exists = registry.exists

@@ -1,0 +1,388 @@
+"""Contrib operators: SSD MultiBox family, CTCLoss, FFT, count_sketch,
+quantization.
+
+TPU-native re-implementations of the reference's CUDA contrib ops
+(ref: src/operator/contrib/multibox_prior.{cc,cu}, multibox_target.*,
+multibox_detection.* — SSD depends on these, example/ssd/symbol/common.py:175;
+contrib/ctc_loss* with vendored warp-ctc kernels; contrib/fft*,
+count_sketch*, quantize*). Design notes:
+
+- MultiBox matching/NMS are reformulated as dense masked reductions with
+  static shapes (anchors capped per class by ``nms_topk``) instead of the
+  reference's atomics — XLA-friendly, no dynamic shapes.
+- CTCLoss is the standard log-space alpha recursion under ``lax.scan``;
+  the gradient comes from autodiff through the scan (no hand-written
+  backward, unlike warp-ctc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import attr_bool, attr_float, attr_int, attr_str, attr_tuple, MXNetError
+from .registry import OpDef, register, register_def
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (ref: contrib/multibox_prior.cc)
+# ---------------------------------------------------------------------------
+
+def _mbp_attrs(attrs):
+    sizes = attr_tuple(attrs.get("sizes", (1.0,)), (1.0,), typ=float)
+    ratios = attr_tuple(attrs.get("ratios", (1.0,)), (1.0,), typ=float)
+    clip = attr_bool(attrs.get("clip", False), False)
+    steps = attr_tuple(attrs.get("steps", (-1.0, -1.0)), (-1.0, -1.0),
+                       typ=float)
+    offsets = attr_tuple(attrs.get("offsets", (0.5, 0.5)), (0.5, 0.5),
+                         typ=float)
+    return sizes, ratios, clip, steps, offsets
+
+
+def _mbp_infer(attrs, in_shapes):
+    sizes, ratios, _, _, _ = _mbp_attrs(attrs)
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("MultiBoxPrior: data shape required")
+    na = len(sizes) + len(ratios) - 1
+    return [tuple(data)], [(1, data[2] * data[3] * na, 4)], []
+
+
+@register("MultiBoxPrior", inputs=("data",), infer_shape=_mbp_infer,
+          aliases=("_contrib_MultiBoxPrior",))
+def _multibox_prior(op_ctx, attrs, inputs, aux):
+    sizes, ratios, clip, steps, offsets = _mbp_attrs(attrs)
+    h, w = inputs[0].shape[2], inputs[0].shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    # anchor list: (size_i, ratio_0) for all i, then (size_0, ratio_j) j>0
+    whs = [(s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])) for s in sizes]
+    whs += [(sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r))
+            for r in ratios[1:]]
+    ws = jnp.array([wh[0] for wh in whs]) / 2.0
+    hs = jnp.array([wh[1] for wh in whs]) / 2.0
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")       # (H, W)
+    gy = gy[..., None]
+    gx = gx[..., None]
+    boxes = jnp.stack([gx - ws, gy - hs, gx + ws, gy + hs], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return (boxes.astype(inputs[0].dtype),)
+
+
+# ---------------------------------------------------------------------------
+# box IOU helper
+# ---------------------------------------------------------------------------
+
+def _iou(a, b):
+    """a: (..., A, 4), b: (..., B, 4) corners -> (..., A, B)."""
+    ax1, ay1, ax2, ay2 = [a[..., i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (ref: contrib/multibox_target.cc)
+# ---------------------------------------------------------------------------
+
+def _mbt_infer(attrs, in_shapes):
+    anchors, labels, cls_preds = in_shapes
+    if anchors is None or labels is None:
+        raise MXNetError("MultiBoxTarget: anchor/label shapes required")
+    a = anchors[1]
+    n = labels[0]
+    return [tuple(anchors), tuple(labels), tuple(cls_preds)], \
+        [(n, a * 4), (n, a * 4), (n, a)], []
+
+
+@register("MultiBoxTarget", inputs=("anchor", "label", "cls_pred"),
+          infer_shape=_mbt_infer, aliases=("_contrib_MultiBoxTarget",))
+def _multibox_target(op_ctx, attrs, inputs, aux):
+    anchors, labels, cls_preds = inputs
+    iou_thresh = attr_float(attrs.get("overlap_threshold", 0.5), 0.5)
+    variances = attr_tuple(attrs.get("variances", (0.1, 0.1, 0.2, 0.2)),
+                           (0.1, 0.1, 0.2, 0.2), typ=float)
+    neg_ratio = attr_float(attrs.get("negative_mining_ratio", -1.0), -1.0)
+    anc = anchors[0]                                  # (A, 4)
+    A = anc.shape[0]
+
+    def one_sample(lab, cls_pred):
+        # lab: (O, 5) [cls, x1, y1, x2, y2], cls -1 padding
+        valid = lab[:, 0] >= 0                        # (O,)
+        gt = lab[:, 1:5]
+        ious = _iou(anc, gt) * valid[None, :]         # (A, O)
+        best_gt = jnp.argmax(ious, axis=1)            # per anchor
+        best_iou = jnp.max(ious, axis=1)
+        # anchors that are argmax for some gt are forced positive
+        best_anchor_per_gt = jnp.argmax(ious, axis=0)  # (O,)
+        # .max, not .set: padded labels all point at anchor 0 and a
+        # duplicate-index .set could overwrite a real gt's forced match
+        forced = jnp.zeros(A, bool).at[best_anchor_per_gt].max(valid)
+        pos = (best_iou >= iou_thresh) | forced
+        matched_gt = gt[best_gt]                      # (A, 4)
+        matched_cls = lab[best_gt, 0]
+        # encode offsets (center form, variance-scaled)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        gw = jnp.maximum(matched_gt[:, 2] - matched_gt[:, 0], 1e-8)
+        gh = jnp.maximum(matched_gt[:, 3] - matched_gt[:, 1], 1e-8)
+        gcx = (matched_gt[:, 0] + matched_gt[:, 2]) / 2
+        gcy = (matched_gt[:, 1] + matched_gt[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=1) * pos[:, None]
+        loc_m = jnp.tile(pos[:, None].astype(anc.dtype), (1, 4))
+        cls_t = jnp.where(pos, matched_cls + 1.0, 0.0)  # 0 = background
+        if neg_ratio > 0:
+            # hard negative mining: keep top neg_ratio*npos negatives by
+            # background-score deficiency, mark the rest ignore (-1)
+            bg_scores = jax.nn.softmax(cls_pred, axis=0)[0]  # (A,)
+            neg_cand = ~pos
+            difficulty = jnp.where(neg_cand, 1.0 - bg_scores, -jnp.inf)
+            order = jnp.argsort(-difficulty)
+            rank = jnp.zeros(A, jnp.int32).at[order].set(jnp.arange(A))
+            npos = jnp.sum(pos)
+            keep_n = jnp.maximum((neg_ratio * npos).astype(jnp.int32), 1)
+            keep_neg = neg_cand & (rank < keep_n)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0, -1.0))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(labels, cls_preds)
+    return (loc_t, loc_m, cls_t)
+
+
+from .registry import get as _get  # noqa: E402
+
+_get("MultiBoxTarget")._outputs = ("loc_target", "loc_mask", "cls_target")
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (ref: contrib/multibox_detection.cc)
+# ---------------------------------------------------------------------------
+
+def _mbd_infer(attrs, in_shapes):
+    cls_prob, loc_pred, anchor = in_shapes
+    if cls_prob is None or anchor is None:
+        raise MXNetError("MultiBoxDetection: shapes required")
+    n = cls_prob[0]
+    a = anchor[1]
+    return [tuple(cls_prob), tuple(loc_pred), tuple(anchor)], \
+        [(n, a, 6)], []
+
+
+@register("MultiBoxDetection", inputs=("cls_prob", "loc_pred", "anchor"),
+          infer_shape=_mbd_infer, aliases=("_contrib_MultiBoxDetection",))
+def _multibox_detection(op_ctx, attrs, inputs, aux):
+    cls_prob, loc_pred, anchors = inputs
+    thresh = attr_float(attrs.get("threshold", 0.01), 0.01)
+    nms_thresh = attr_float(attrs.get("nms_threshold", 0.5), 0.5)
+    variances = attr_tuple(attrs.get("variances", (0.1, 0.1, 0.2, 0.2)),
+                           (0.1, 0.1, 0.2, 0.2), typ=float)
+    force = attr_bool(attrs.get("force_suppress", False), False)
+    nms_topk = attr_int(attrs.get("nms_topk", -1), -1)
+    anc = anchors[0]
+    A = anc.shape[0]
+
+    # decode
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+
+    def one_sample(cp, lp):
+        lp = lp.reshape(A, 4)
+        cx = lp[:, 0] * variances[0] * aw + acx
+        cy = lp[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(lp[:, 2] * variances[2]) * aw / 2
+        h = jnp.exp(lp[:, 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+        # per anchor best non-background class
+        scores = cp[1:]                       # (C-1, A)
+        cls = jnp.argmax(scores, axis=0)      # (A,)
+        score = jnp.max(scores, axis=0)
+        keep = score > thresh
+        score = jnp.where(keep, score, 0.0)
+        # greedy NMS over anchors sorted by score
+        k = A if nms_topk <= 0 else min(nms_topk, A)
+        order = jnp.argsort(-score)[:k]
+        sboxes = boxes[order]
+        sscore = score[order]
+        scls = cls[order]
+        ious = _iou(sboxes, sboxes)           # (k, k)
+        same_cls = (scls[:, None] == scls[None, :]) | force
+        sup_matrix = (ious > nms_thresh) & same_cls
+
+        def body(i, alive):
+            sup = sup_matrix[i] & alive[i] & (jnp.arange(k) > i)
+            return alive & ~sup
+
+        alive = jax.lax.fori_loop(0, k, body, sscore > 0)
+        out_cls = jnp.where(alive, scls.astype(cp.dtype), -1.0)
+        out_score = jnp.where(alive, sscore, 0.0)
+        det = jnp.concatenate([out_cls[:, None], out_score[:, None], sboxes],
+                              axis=1)
+        if k < A:
+            pad = jnp.full((A - k, 6), -1.0, det.dtype)
+            det = jnp.concatenate([det, pad], axis=0)
+        return det
+
+    return (jax.vmap(one_sample)(cls_prob, loc_pred),)
+
+
+# ---------------------------------------------------------------------------
+# CTCLoss (ref: contrib/ctc_loss*; warp-ctc semantics, blank = 0)
+# ---------------------------------------------------------------------------
+
+def _ctc_infer(attrs, in_shapes):
+    data, label = in_shapes
+    if data is None:
+        raise MXNetError("CTCLoss: data shape required")
+    return [tuple(data), tuple(label)], [(data[1],)], []
+
+
+@register("CTCLoss", inputs=("data", "label"), infer_shape=_ctc_infer,
+          aliases=("ctc_loss", "_contrib_CTCLoss"))
+def _ctc_loss(op_ctx, attrs, inputs, aux):
+    data, label = inputs     # data: (T, N, V) activations; label: (N, L)
+    T, N, V = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ... blank (blank = 0)
+    S = 2 * L + 1
+    ext = jnp.zeros((N, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    lab_len = jnp.sum((lab > 0).astype(jnp.int32), axis=1)
+    ext_len = 2 * lab_len + 1
+    NEG = -1e9
+
+    # can-skip mask: allowed to jump from s-2 to s when ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)))[:, :S]
+    can_skip = (ext != 0) & (ext != ext_prev2)
+
+    def get_logp(t):
+        # (N, S): log prob of emitting ext symbol s at time t
+        return jnp.take_along_axis(logp[t], ext, axis=1)
+
+    alpha0 = jnp.full((N, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(get_logp(0)[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, get_logp(0)[:, 1],
+                                           NEG))
+
+    def lse(a, b):
+        # NaN-safe log-add-exp: clamp the gap so neither branch of the
+        # computation can produce inf/NaN in the vjp (the where-grad trap)
+        m = jnp.maximum(a, b)
+        d = jnp.clip(jnp.abs(a - b), 0.0, 60.0)
+        return m + jnp.log1p(jnp.exp(-d))
+
+    def step(alpha, t):
+        a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                          constant_values=NEG)[:, :S]
+        a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                          constant_values=NEG)[:, :S]
+        a = lse(alpha, a_prev1)
+        a = jnp.where(can_skip, lse(a, a_prev2), a)
+        alpha_new = a + get_logp(t)
+        return alpha_new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # loss = -log(alpha[ext_len-1] + alpha[ext_len-2])
+    idx_last = jnp.clip(ext_len - 1, 0, S - 1)
+    idx_prev = jnp.clip(ext_len - 2, 0, S - 1)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+    # empty-label rows (ext_len==1) have no second terminal state — using
+    # lse(a, a) there would double-count the all-blank path
+    total = jnp.where(ext_len >= 2, lse(a_last, a_prev), a_last)
+    return (-total,)
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT (ref: contrib/fft* — cuFFT there, jnp.fft here)
+# ---------------------------------------------------------------------------
+
+@register("fft", inputs=("data",), aliases=("_contrib_fft",))
+def _fft(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    y = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+    # reference packs complex interleaved [re, im] doubling the last dim
+    out = jnp.stack([jnp.real(y), jnp.imag(y)], axis=-1)
+    return (out.reshape(x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype),)
+
+
+@register("ifft", inputs=("data",), aliases=("_contrib_ifft",))
+def _ifft(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    n = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (n, 2))
+    z = pairs[..., 0] + 1j * pairs[..., 1]
+    y = jnp.fft.ifft(z, axis=-1) * n  # reference does unnormalized ifft
+    return (jnp.real(y).astype(x.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (ref: contrib/count_sketch* — compact bilinear pooling)
+# ---------------------------------------------------------------------------
+
+def _cs_infer(attrs, in_shapes):
+    data, h, s = in_shapes
+    out_dim = attr_int(attrs["out_dim"])
+    if data is None:
+        raise MXNetError("count_sketch: data shape required")
+    return [tuple(data), (data[1],), (data[1],)], [(data[0], out_dim)], []
+
+
+@register("count_sketch", inputs=("data", "h", "s"), infer_shape=_cs_infer,
+          aliases=("_contrib_count_sketch",))
+def _count_sketch(op_ctx, attrs, inputs, aux):
+    data, h, s = inputs
+    out_dim = attr_int(attrs["out_dim"])
+    idx = h.astype(jnp.int32) % out_dim
+    signed = data * s[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return (out.at[:, idx].add(signed),)
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize (ref: contrib/quantize*)
+# ---------------------------------------------------------------------------
+
+@register("quantize", inputs=("data", "min_range", "max_range"),
+          aliases=("_contrib_quantize",))
+def _quantize(op_ctx, attrs, inputs, aux):
+    data, lo, hi = inputs
+    scale = 255.0 / jnp.maximum(hi - lo, 1e-8)
+    q = jnp.clip(jnp.round((data - lo) * scale), 0, 255).astype(jnp.uint8)
+    return (q, lo, hi)
+
+
+_get("quantize")._outputs = ("output", "min_range", "max_range")
+
+
+@register("dequantize", inputs=("data", "min_range", "max_range"),
+          aliases=("_contrib_dequantize",))
+def _dequantize(op_ctx, attrs, inputs, aux):
+    data, lo, hi = inputs
+    scale = jnp.maximum(hi - lo, 1e-8) / 255.0
+    return (data.astype(jnp.float32) * scale + lo,)

@@ -113,11 +113,11 @@ def _conv(op_ctx, attrs, inputs, aux):
         x.shape, w.shape,
         ("NCHW", "OIHW", "NCHW") if nd == 2 else
         ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
+    # no preferred_element_type: the MXU accumulates bf16 matmuls in fp32
+    # internally, and a widened output dtype breaks the conv transpose rule
     y = jax.lax.conv_general_dilated(
         x, w, window_strides=stride, padding=[(p, p) for p in pad],
-        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=ng,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    y = y.astype(x.dtype)
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=ng)
     if not no_bias:
         b = inputs[2].reshape((1, nf) + (1,) * nd)
         y = y + b
@@ -575,11 +575,12 @@ def _make_loss_fn(grad_scale):
         return data
 
     def fwd(data):
-        return data, (data.shape, str(data.dtype))
+        return data, None
 
     def bwd(res, g):
-        shape, dtype = res
-        return (jnp.full(shape, grad_scale, jnp.dtype(dtype)),)
+        # the cotangent carries shape/dtype; its value is ignored (ref:
+        # make_loss backward emits grad_scale regardless of out_grad)
+        return (jnp.full(g.shape, grad_scale, g.dtype),)
 
     make_loss.defvjp(fwd, bwd)
     return make_loss

@@ -1,0 +1,203 @@
+"""Fused multi-layer RNN operator (RNN): vanilla/LSTM/GRU, bidirectional.
+
+TPU-native replacement for the reference's cuDNN-only fused RNN
+(ref: src/operator/rnn-inl.h:315 — CPU path is LOG(FATAL) "not implemented";
+cudnn_rnn-inl.h:549). Here the recurrence is a ``lax.scan`` per layer and
+direction — compiler-friendly control flow the MXU can pipeline — working on
+every backend, with gradients from jax.vjp instead of cuDNN's backward.
+
+Interface parity with the reference RNN op:
+  inputs: data (T, N, C), parameters (flat vector), state (L*D, N, H)
+          [, state_cell (L*D, N, H) for lstm]
+  attrs:  state_size, num_layers, mode {rnn_relu, rnn_tanh, lstm, gru},
+          bidirectional, p (inter-layer dropout), state_outputs
+  outputs: output (T, N, H*D) [, state_out [, statecell_out]]
+
+Packed parameter layout (cuDNN-compatible ordering, which FusedRNNCell's
+unfuse()/unpack rely on): per layer, per direction: W_x (G*H, I) then
+W_h (G*H, H); after ALL weights come the biases: per layer, per direction:
+b_x (G*H,) then b_h (G*H,). Gate order: LSTM i,f,g,o; GRU r,z,n.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_float, attr_int, attr_str, MXNetError
+from .registry import OpDef, register_def
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    """Total packed parameter count (matches the layout above)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    total = 0
+    for layer in range(num_layers):
+        i = input_size if layer == 0 else h * d
+        total += d * (g * h * i + g * h * h)   # weights
+    total += num_layers * d * 2 * g * h        # biases
+    return total
+
+
+def _param_slices(mode, input_size, state_size, num_layers, bidirectional):
+    """Static offsets of each (layer, dir) -> (Wx, Wh, bx, bh) slice."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    slices = {}
+    off = 0
+    for layer in range(num_layers):
+        i = input_size if layer == 0 else h * d
+        for dr in range(d):
+            wx = (off, g * h * i, (g * h, i)); off += g * h * i
+            wh = (off, g * h * h, (g * h, h)); off += g * h * h
+            slices[(layer, dr)] = [wx, wh, None, None]
+    for layer in range(num_layers):
+        for dr in range(d):
+            bx = (off, g * h, (g * h,)); off += g * h
+            bh = (off, g * h, (g * h,)); off += g * h
+            slices[(layer, dr)][2] = bx
+            slices[(layer, dr)][3] = bh
+    return slices, off
+
+
+def _take(params, spec):
+    off, n, shape = spec
+    return jax.lax.dynamic_slice(params, (off,), (n,)).reshape(shape)
+
+
+def _cell_step(mode, x_proj, h_prev, c_prev, wh, bh):
+    """One timestep given the precomputed input projection."""
+    gates = x_proj + jnp.dot(h_prev, wh.T) + bh
+    state_size = h_prev.shape[-1]
+    if mode == "lstm":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return h, c
+    if mode == "gru":
+        # GRU with cuDNN-style reset-after-projection on hidden candidate
+        hr = jnp.dot(h_prev, wh.T) + bh
+        xr = x_proj
+        r = jax.nn.sigmoid(xr[..., :state_size] + hr[..., :state_size])
+        z = jax.nn.sigmoid(xr[..., state_size:2 * state_size]
+                           + hr[..., state_size:2 * state_size])
+        n = jnp.tanh(xr[..., 2 * state_size:]
+                     + r * hr[..., 2 * state_size:])
+        hnew = (1 - z) * n + z * h_prev
+        return hnew, c_prev
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+    hnew = act(gates)
+    return hnew, c_prev
+
+
+def _run_direction(mode, xs, h0, c0, wx, wh, bx, bh, reverse):
+    """Scan one layer in one direction. xs: (T, N, I)."""
+    # hoist the input projection out of the scan: one big MXU matmul
+    x_proj = jnp.einsum("tni,gi->tng", xs, wx) + bx
+
+    def step(carry, xp):
+        h_prev, c_prev = carry
+        h, c = _cell_step(mode, xp, h_prev, c_prev, wh, bh)
+        return (h, c), h
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), x_proj, reverse=reverse)
+    return ys, hT, cT
+
+
+def _rnn_inputs(attrs):
+    mode = attr_str(attrs.get("mode", "lstm"), "lstm")
+    if mode == "lstm":
+        return ["data", "parameters", "state", "state_cell"]
+    return ["data", "parameters", "state"]
+
+
+def _rnn_outputs(attrs):
+    mode = attr_str(attrs.get("mode", "lstm"), "lstm")
+    if attr_bool(attrs.get("state_outputs", False), False):
+        return (["output", "state_out", "statecell_out"] if mode == "lstm"
+                else ["output", "state_out"])
+    return ["output"]
+
+
+def _rnn_infer(attrs, in_shapes):
+    mode = attr_str(attrs.get("mode", "lstm"), "lstm")
+    h = attr_int(attrs["state_size"])
+    L = attr_int(attrs.get("num_layers", 1), 1)
+    bi = attr_bool(attrs.get("bidirectional", False), False)
+    d = 2 if bi else 1
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("RNN: data shape required")
+    t, n, c = data
+    psize = rnn_param_size(mode, c, h, L, bi)
+    shapes = [tuple(data), (psize,), (L * d, n, h)]
+    if mode == "lstm":
+        shapes.append((L * d, n, h))
+    outs = [(t, n, h * d)]
+    if attr_bool(attrs.get("state_outputs", False), False):
+        outs.append((L * d, n, h))
+        if mode == "lstm":
+            outs.append((L * d, n, h))
+    return shapes, outs, []
+
+
+def _rnn(op_ctx, attrs, inputs, aux):
+    mode = attr_str(attrs.get("mode", "lstm"), "lstm")
+    h = attr_int(attrs["state_size"])
+    L = attr_int(attrs.get("num_layers", 1), 1)
+    bi = attr_bool(attrs.get("bidirectional", False), False)
+    p = attr_float(attrs.get("p", 0.0), 0.0)
+    state_outputs = attr_bool(attrs.get("state_outputs", False), False)
+    d = 2 if bi else 1
+    data, params = inputs[0], inputs[1]
+    state = inputs[2]
+    state_cell = inputs[3] if mode == "lstm" else jnp.zeros_like(state)
+    t, n, c = data.shape
+    slices, total = _param_slices(mode, c, h, L, bi)
+    if params.shape[0] != total:
+        raise MXNetError("RNN: parameters size %d != expected %d"
+                         % (params.shape[0], total))
+
+    xs = data
+    h_outs = []
+    c_outs = []
+    for layer in range(L):
+        ys_dirs = []
+        for dr in range(d):
+            wx = _take(params, slices[(layer, dr)][0])
+            wh = _take(params, slices[(layer, dr)][1])
+            bx = _take(params, slices[(layer, dr)][2])
+            bh = _take(params, slices[(layer, dr)][3])
+            idx = layer * d + dr
+            ys, hT, cT = _run_direction(mode, xs, state[idx], state_cell[idx],
+                                        wx, wh, bx, bh, reverse=(dr == 1))
+            ys_dirs.append(ys)
+            h_outs.append(hT)
+            c_outs.append(cT)
+        xs = (jnp.concatenate(ys_dirs, axis=-1) if d == 2 else ys_dirs[0])
+        if p > 0 and layer < L - 1 and op_ctx.is_train and op_ctx.rng is not None:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(op_ctx.rng, layer), keep, xs.shape)
+            xs = jnp.where(mask, xs / keep, 0.0).astype(xs.dtype)
+
+    outs = [xs]
+    if state_outputs:
+        outs.append(jnp.stack(h_outs))
+        if mode == "lstm":
+            outs.append(jnp.stack(c_outs))
+    return tuple(outs)
+
+
+_RNN = register_def(OpDef("RNN", _rnn, inputs=("data", "parameters", "state"),
+                          infer_shape=_rnn_infer, var_outputs=_rnn_outputs,
+                          needs_rng=True))
+_RNN.list_inputs = _rnn_inputs

@@ -336,8 +336,7 @@ def _dot(op_ctx, attrs, inputs, aux):
         a = a.T
     if attr_bool(attrs.get("transpose_b", False), False):
         b = b.T
-    return (jnp.dot(a, b, preferred_element_type=jnp.float32
-                    if a.dtype == jnp.bfloat16 else None).astype(a.dtype),)
+    return (jnp.dot(a, b),)
 
 
 @register("batch_dot", inputs=("lhs", "rhs"))

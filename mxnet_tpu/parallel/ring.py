@@ -70,9 +70,10 @@ def blockwise_attention(q, k, v, block_size=None, causal=False):
         acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vblk)
         return (m_new, l_new, acc_new), None
 
-    m0 = jnp.full((b, h, sq), -jnp.inf, q.dtype)
-    l0 = jnp.zeros((b, h, sq), q.dtype)
-    acc0 = jnp.zeros((b, h, sq, d), q.dtype)
+    # carries derived from q keep any shard_map varying manual axes
+    m0 = jnp.full_like(q[..., 0], -jnp.inf)
+    l0 = jnp.zeros_like(q[..., 0])
+    acc0 = jnp.zeros_like(q)
     (m, l, acc), _ = jax.lax.scan(
         body, (m0, l0, acc0),
         (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0), jnp.arange(nblocks)))
@@ -115,9 +116,11 @@ def ring_attention(q, k, v, axis_name="seq", causal=False):
         vr = jax.lax.ppermute(vr, axis_name, perm)
         return (m_new, l_new, acc_new, kr, vr), None
 
-    m0 = jnp.full((b, h, sq), -jnp.inf, q.dtype)
-    l0 = jnp.zeros((b, h, sq), q.dtype)
-    acc0 = jnp.zeros((b, h, sq, d), q.dtype)
+    # derive carries from q so they inherit the 'seq' varying manual axis
+    # (shard_map requires scan carry in/out types to match)
+    m0 = jnp.full_like(q[..., 0], -jnp.inf)
+    l0 = jnp.zeros_like(q[..., 0])
+    acc0 = jnp.zeros_like(q)
     (m, l, acc, _, _), _ = jax.lax.scan(
         step, (m0, l0, acc0, k, v), jnp.arange(n))
     return acc / jnp.maximum(l, 1e-20)[..., None]

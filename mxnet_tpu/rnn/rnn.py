@@ -1,0 +1,34 @@
+"""RNN checkpoint helpers (ref: python/mxnet/rnn/rnn.py): save/load model
+params with cell-aware weight packing."""
+from __future__ import annotations
+
+from .. import model as _model
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """Pack fused weights via the cells then save (ref: rnn.py)."""
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    for cell in cells:
+        arg_params = cell.pack_weights(arg_params)
+    _model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load then unpack weights via the cells (ref: rnn.py)."""
+    sym, arg, aux = _model.load_checkpoint(prefix, epoch)
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    for cell in cells:
+        arg = cell.unpack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback variant (ref: rnn.py do_rnn_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

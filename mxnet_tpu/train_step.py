@@ -1,0 +1,227 @@
+"""Fused training step: forward + backward + optimizer update in ONE jit.
+
+This is the TPU-native analog of everything the reference engine pipeline did
+per batch — RunOps over bulked segments, gradient reduce, updater
+(ref: call stack SURVEY.md §3.1) — collapsed into a single donated XLA
+computation. Module uses the lazy executor path for API fidelity; this module
+is the performance path used by bench.py, the multichip dry-run, and any
+training loop that wants max throughput.
+
+Sharding: pass a Mesh plus optional per-parameter PartitionSpecs. Batch
+arrays are sharded along ``data``; parameters default to replicated
+(pure DP — XLA inserts the gradient psum exactly where the reference ran its
+CommDevice reduce) and any parameter given a spec with a ``model`` axis is
+tensor-parallel sharded.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .executor import _build_graph_runner
+from .initializer import Xavier, InitDesc
+from .ndarray import NDArray
+from .ops import registry as _reg
+from . import random as _random
+
+P = jax.sharding.PartitionSpec
+
+
+def _sgd_mom_init(shape, dtype):
+    return jnp.zeros(shape, dtype)
+
+
+class TrainStep(object):
+    """Compiled train step over a symbol.
+
+    state = {params, aux, opt, step}; ``step(state, batch)`` returns
+    (new_state, outputs) and donates the old state buffers.
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), optimizer="sgd",
+                 learning_rate=0.01, momentum=0.9, wd=0.0, rescale_grad=None,
+                 mesh=None, param_shardings=None, dtype=np.float32,
+                 compute_dtype=None, remat=False):
+        self.symbol = symbol
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.param_names = [n for n in self.arg_names
+                            if n not in self.data_names + self.label_names]
+        self.optimizer = optimizer
+        self.lr = learning_rate
+        self.momentum = momentum
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.mesh = mesh
+        self.param_shardings = dict(param_shardings or {})
+        self.dtype = np.dtype(dtype)
+        self.compute_dtype = (np.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
+        self._run, self._nodes = _build_graph_runner(symbol)
+        self._needs_rng = any((not n.is_variable) and n.op.needs_rng
+                              for n in self._nodes)
+        if remat:
+            self._run = self._wrap_remat(self._run)
+        self._jit = {}  # keyed by batch size (rescale_grad depends on it)
+
+    # ------------------------------------------------------------------
+    def _wrap_remat(self, run):
+        """Memory mirroring: recompute activations in backward
+        (ref: MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:213-226 — here a
+        single jax.checkpoint over the whole forward)."""
+        def wrapped(arg_vals, aux_vals, key, is_train):
+            def inner(arg_vals):
+                return run(arg_vals, aux_vals, key, is_train)
+            return jax.checkpoint(inner)(arg_vals)
+        return wrapped
+
+    # ------------------------------------------------------------------
+    def init(self, data_shapes, label_shapes=None, initializer=None, seed=0):
+        """Allocate and initialize state from inferred shapes."""
+        shapes = dict(data_shapes)
+        shapes.update(label_shapes or {})
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        shape_of = dict(zip(self.arg_names, arg_shapes))
+        aux_shape_of = dict(zip(self.aux_names, aux_shapes))
+        initializer = initializer or Xavier()
+        _random.seed(seed)
+        attrs = self.symbol.attr_dict()
+        params = {}
+        for n in self.param_names:
+            arr = NDArray(jnp.zeros(shape_of[n], self.dtype))
+            initializer(InitDesc(n, attrs.get(n, {})), arr)
+            params[n] = arr.data
+        aux = {}
+        for n in self.aux_names:
+            arr = NDArray(jnp.zeros(aux_shape_of[n], self.dtype))
+            initializer(InitDesc(n, attrs.get(n, {})), arr)
+            aux[n] = arr.data
+        opt = self._init_opt_state(params)
+        state = {"params": params, "aux": aux, "opt": opt,
+                 "step": jnp.zeros((), jnp.int32)}
+        if self.mesh is not None:
+            state = self._shard_state(state)
+        return state
+
+    def _init_opt_state(self, params):
+        if self.optimizer == "sgd" and self.momentum:
+            return {"mom": {n: jnp.zeros_like(v) for n, v in params.items()}}
+        if self.optimizer == "adam":
+            return {"mean": {n: jnp.zeros_like(v) for n, v in params.items()},
+                    "var": {n: jnp.zeros_like(v) for n, v in params.items()}}
+        return {}
+
+    # ------------------------------------------------------------------
+    def _param_spec(self, name):
+        return self.param_shardings.get(name, P())
+
+    def _shard_state(self, state):
+        mesh = self.mesh
+
+        def put_params(tree):
+            return {n: jax.device_put(
+                v, jax.sharding.NamedSharding(mesh, self._param_spec(n)))
+                for n, v in tree.items()}
+
+        out = dict(state)
+        out["params"] = put_params(state["params"])
+        out["opt"] = {k: put_params(v) for k, v in state["opt"].items()}
+        repl = jax.sharding.NamedSharding(mesh, P())
+        out["aux"] = {n: jax.device_put(v, repl)
+                      for n, v in state["aux"].items()}
+        out["step"] = jax.device_put(state["step"], repl)
+        return out
+
+    def shard_batch(self, batch):
+        """device_put batch arrays with dim-0 sharded along the data axis."""
+        if self.mesh is None:
+            return batch
+        s = jax.sharding.NamedSharding(self.mesh, P("data"))
+        return {k: jax.device_put(jnp.asarray(v), s) for k, v in batch.items()}
+
+    # ------------------------------------------------------------------
+    def _build(self, batch_size):
+        run = self._run
+        param_names = list(self.param_names)
+        lr, momentum, wd = self.lr, self.momentum, self.wd
+        rescale = (self.rescale_grad if self.rescale_grad is not None
+                   else 1.0 / batch_size)
+        optimizer = self.optimizer
+        compute_dtype = self.compute_dtype
+
+        def step_fn(state, batch, key):
+            params, aux, opt = state["params"], state["aux"], state["opt"]
+
+            def f(p):
+                arg_vals = dict(batch)
+                if compute_dtype is not None:
+                    arg_vals = {
+                        k: (v.astype(compute_dtype)
+                            if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                        for k, v in arg_vals.items()}
+                    p = {k: v.astype(compute_dtype) for k, v in p.items()}
+                arg_vals.update(p)
+                outs, aux_up = run(arg_vals, aux, key, True)
+                return outs, aux_up
+
+            (outs, aux_up), vjp_fn = jax.vjp(f, params)
+            cots = [jnp.ones_like(o) for o in outs]
+            cots_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
+            (grads,) = vjp_fn((cots, cots_aux))
+            grads = {n: grads[n].astype(state["params"][n].dtype)
+                     for n in param_names}
+
+            new_params = {}
+            new_opt = {k: dict(v) for k, v in opt.items()}
+            for n in param_names:
+                w, g = params[n], grads[n]
+                g = g * rescale
+                if optimizer == "sgd" and momentum:
+                    m = momentum * opt["mom"][n] - lr * (g + wd * w)
+                    new_params[n] = w + m
+                    new_opt["mom"][n] = m
+                elif optimizer == "sgd":
+                    new_params[n] = w - lr * (g + wd * w)
+                elif optimizer == "adam":
+                    t = state["step"].astype(jnp.float32) + 1.0
+                    b1, b2, eps = 0.9, 0.999, 1e-8
+                    g = g + wd * w  # ref: python Adam applies wd to the grad
+                    mean = b1 * opt["mean"][n] + (1 - b1) * g
+                    var = b2 * opt["var"][n] + (1 - b2) * g * g
+                    lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+                    new_params[n] = w - lr_t * mean / (jnp.sqrt(var) + eps)
+                    new_opt["mean"][n] = mean
+                    new_opt["var"][n] = var
+                else:
+                    raise MXNetError("fused step: optimizer %r unsupported"
+                                     % optimizer)
+            new_aux = dict(aux)
+            for k, v in aux_up.items():
+                new_aux[k] = v.astype(aux[k].dtype)
+            new_state = {"params": new_params, "aux": new_aux,
+                         "opt": new_opt, "step": state["step"] + 1}
+            return new_state, outs
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def step(self, state, batch):
+        """One fused train step. ``batch``: dict name -> array."""
+        bs = next(iter(batch.values())).shape[0]
+        if bs not in self._jit:
+            self._jit[bs] = self._build(bs)
+        if self._needs_rng:
+            key = jax.random.fold_in(jax.random.key(0), state["step"])
+        else:
+            key = jax.random.key(0)  # static; unused ops ignore it
+        return self._jit[bs](state, batch, key)
+
+
+def data_parallel_spec(mesh_shape, n_devices=None, devices=None):
+    """Helper: build a mesh dict for make-style calls."""
+    from .parallel.mesh import make_mesh
+    return make_mesh(mesh_shape, devices)

@@ -1,0 +1,157 @@
+"""Spatial + contrib op tests (ref strategy: test_operator.py spatial
+sections; SSD op behavior from contrib/multibox_*)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def test_grid_generator_identity():
+    # identity affine [1,0,0, 0,1,0] -> identity grid
+    theta = nd.array(np.array([[1.0, 0, 0, 0, 1.0, 0]], np.float32))
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(4, 4))
+    g = grid.asnumpy()
+    assert g.shape == (1, 2, 4, 4)
+    assert np.allclose(g[0, 0, 0], np.linspace(-1, 1, 4), atol=1e-5)
+    assert np.allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4), atol=1e-5)
+
+
+def test_bilinear_sampler_identity():
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    theta = nd.array(np.array([[1.0, 0, 0, 0, 1.0, 0]], np.float32))
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(5, 5))
+    out = mx.nd.BilinearSampler(nd.array(x), grid)
+    assert np.allclose(out.asnumpy(), x, atol=1e-4)
+
+
+def test_spatial_transformer_identity():
+    x = np.random.rand(2, 3, 6, 6).astype(np.float32)
+    loc = np.tile(np.array([1.0, 0, 0, 0, 1.0, 0], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(nd.array(x), nd.array(loc),
+                                   target_shape=(6, 6),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    assert np.allclose(out.asnumpy(), x, atol=1e-4)
+
+
+def test_roi_pooling():
+    # feature map with known max positions
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)  # whole image
+    out = mx.nd.ROIPooling(nd.array(x), nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0)
+    o = out.asnumpy()
+    assert o.shape == (1, 1, 2, 2)
+    assert o[0, 0, 1, 1] == 15.0  # bottom-right bin max
+    assert o[0, 0, 0, 0] == 5.0   # top-left 2x2 bin max
+
+
+def test_multibox_prior():
+    data = nd.zeros((1, 8, 2, 2))
+    anchors = mx.nd.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4, 4)
+    # first anchor centered at (0.25, 0.25), size 0.5 -> [0, 0, 0.5, 0.5]
+    assert np.allclose(a[0, 0], [0, 0, 0.5, 0.5], atol=1e-5)
+
+
+def test_multibox_target_matching():
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.5, 0.5],
+                                  [0.5, 0.5, 1.0, 1.0]]], np.float32))
+    # one gt box overlapping anchor 0 heavily
+    labels = nd.array(np.array([[[0.0, 0.05, 0.05, 0.45, 0.45]]], np.float32))
+    cls_preds = nd.zeros((1, 2, 2))
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(anchors, labels, cls_preds)
+    assert cls_t.asnumpy()[0, 0] == 1.0   # matched -> class 0 + 1
+    assert cls_t.asnumpy()[0, 1] == 0.0   # background
+    assert loc_m.asnumpy()[0, :4].sum() == 4.0
+    assert loc_m.asnumpy()[0, 4:].sum() == 0.0
+
+
+def test_multibox_detection_nms():
+    # two overlapping anchors, same class; NMS keeps higher score
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.12, 0.12, 0.52, 0.52],
+                                  [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    cls_prob = nd.array(np.array([[[0.1, 0.2, 0.1],       # background
+                                   [0.9, 0.8, 0.9]]], np.float32))
+    loc_pred = nd.zeros((1, 12))
+    det = mx.nd.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                  nms_threshold=0.5)
+    d = det.asnumpy()[0]
+    kept = d[d[:, 0] >= 0]
+    # anchor 1 suppressed by anchor 0 (higher score, same class, iou>0.5)
+    assert len(kept) == 2
+
+
+def test_ctc_loss_perfect_prediction():
+    # if the net predicts the labels with certainty, loss ~ 0
+    T, N, V, L = 4, 1, 3, 2
+    acts = np.full((T, N, V), -10.0, np.float32)
+    # labels [1, 2]: emit 1, 1, 2, 2 (collapses to [1,2])
+    acts[0, 0, 1] = 10.0
+    acts[1, 0, 1] = 10.0
+    acts[2, 0, 2] = 10.0
+    acts[3, 0, 2] = 10.0
+    label = np.array([[1, 2]], np.float32)
+    loss = mx.nd.CTCLoss(nd.array(acts), nd.array(label))
+    assert loss.asnumpy()[0] < 0.1
+
+
+def test_ctc_loss_gradient_flows():
+    T, N, V = 5, 2, 4
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    loss = sym.MakeLoss(data=sym.CTCLoss(data=data, label=label, name="ctc"))
+    x = np.random.uniform(-1, 1, (T, N, V)).astype(np.float32)
+    lab = np.array([[1, 2], [3, 0]], np.float32)
+    ag = nd.zeros((T, N, V))
+    ex = loss.bind(mx.cpu(), {"data": nd.array(x), "label": nd.array(lab)},
+                   args_grad={"data": ag},
+                   grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.abs(ag.asnumpy()).sum() > 0
+
+
+def test_fft_roundtrip():
+    x = np.random.rand(2, 8).astype(np.float32)
+    f = mx.nd.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    back = mx.nd.ifft(f) / 8  # reference ifft is unnormalized
+    assert np.allclose(back.asnumpy(), x, atol=1e-4)
+
+
+def test_quantize_roundtrip():
+    x = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    lo = nd.array(np.array(-1.0, np.float32).reshape(1))
+    hi = nd.array(np.array(1.0, np.float32).reshape(1))
+    q, qlo, qhi = mx.nd.quantize(nd.array(x), lo, hi)
+    deq = mx.nd.dequantize(q, qlo, qhi)
+    assert np.allclose(deq.asnumpy(), x, atol=0.01)
+
+
+def test_count_sketch():
+    x = np.ones((1, 4), np.float32)
+    h = nd.array(np.array([0, 1, 0, 1], np.float32))
+    s = nd.array(np.array([1, 1, -1, 1], np.float32))
+    out = mx.nd.count_sketch(nd.array(x), h, s, out_dim=2)
+    assert np.allclose(out.asnumpy(), [[0.0, 2.0]])
+
+
+def test_correlation_self():
+    x = np.random.rand(1, 4, 6, 6).astype(np.float32)
+    out = mx.nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                            max_displacement=1, stride1=1, stride2=1,
+                            pad_size=1)
+    o = out.asnumpy()
+    assert o.shape[1] == 9  # 3x3 displacement window
+    # zero displacement channel (center, index 4) == mean of squares
+    center = o[0, 4]
+    expect = (x * x).mean(axis=1)[0]
+    # cropped to the valid region
+    assert np.allclose(center, expect[:center.shape[0], :center.shape[1]],
+                       atol=1e-4)

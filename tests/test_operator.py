@@ -80,7 +80,10 @@ def test_global_pooling():
 
 
 def test_activation_grads():
-    x = np.random.rand(3, 4).astype(np.float32) - 0.5
+    # keep |x| > 0.05: finite differences are ill-defined at the relu kink
+    rng = np.random.default_rng(3)
+    x = rng.uniform(0.05, 0.5, (3, 4)).astype(np.float32)
+    x *= rng.choice([-1.0, 1.0], x.shape).astype(np.float32)
     for act in ["relu", "sigmoid", "tanh", "softrelu"]:
         data = sym.Variable("data")
         s = sym.Activation(data=data, act_type=act)

@@ -1,0 +1,163 @@
+"""RNN toolkit tests (ref strategy: tests/python/unittest/test_rnn.py —
+cell unroll vs fused consistency)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.rnn import (RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+                           SequentialRNNCell, BidirectionalCell, DropoutCell,
+                           BucketSentenceIter, encode_sentences)
+
+
+def _bind_unrolled(outputs, states, batch, seq, dim, hidden, extra=None):
+    net = sym.Group(outputs if isinstance(outputs, list) else [outputs])
+    shapes = {"t%d_data" % i: (batch, dim) for i in range(seq)}
+    if extra:
+        shapes.update(extra)
+    arg_shapes, out_shapes, _ = net.infer_shape_partial(**shapes)
+    return net, arg_shapes, out_shapes
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = RNNCell(num_hidden=16, prefix="rnn_")
+    outputs, states = cell.unroll(3, input_prefix="rnn_")
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    net = sym.Group(outputs)
+    assert net.list_outputs() == ["rnn_t0_out_output", "rnn_t1_out_output",
+                                  "rnn_t2_out_output"]
+    shapes = {"rnn_t%d_data" % i: (10, 50) for i in range(3)}
+    shapes["rnn_begin_state_0"] = (10, 16)
+    _, outs, _ = net.infer_shape(**shapes)
+    assert outs == [(10, 16)] * 3
+
+
+def test_lstm_cell_unroll_executes():
+    cell = LSTMCell(num_hidden=8, prefix="lstm_")
+    outputs, states = cell.unroll(4, input_prefix="lstm_")
+    net = sym.Group(outputs)
+    shapes = {"lstm_t%d_data" % i: (2, 5) for i in range(4)}
+    shapes.update({"lstm_begin_state_0": (2, 8),
+                   "lstm_begin_state_1": (2, 8)})
+    ex = net.simple_bind(mx.cpu(), **shapes)
+    for k, v in ex.arg_dict.items():
+        v[:] = np.random.uniform(-0.1, 0.1, v.shape)
+    ex.forward()
+    assert ex.outputs[0].shape == (2, 8)
+    assert len(ex.outputs) == 4
+
+
+def test_gru_cell():
+    cell = GRUCell(num_hidden=8, prefix="gru_")
+    outputs, states = cell.unroll(2, input_prefix="gru_")
+    net = sym.Group(outputs)
+    shapes = {"gru_t%d_data" % i: (2, 4) for i in range(2)}
+    shapes["gru_begin_state_0"] = (2, 8)
+    ex = net.simple_bind(mx.cpu(), **shapes)
+    ex.forward()
+    assert ex.outputs[0].shape == (2, 8)
+
+
+def test_fused_rnn_op_shapes():
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    T, N, C, H, L = 5, 3, 4, 8, 2
+    psize = rnn_param_size("lstm", C, H, L, False)
+    data = nd.array(np.random.uniform(-1, 1, (T, N, C)).astype(np.float32))
+    params = nd.array(np.random.uniform(-0.1, 0.1, (psize,)).astype(np.float32))
+    state = nd.zeros((L, N, H))
+    cell_state = nd.zeros((L, N, H))
+    out = mx.nd.RNN(data, params, state, cell_state, state_size=H,
+                    num_layers=L, mode="lstm", state_outputs=True)
+    assert out[0].shape == (T, N, H)
+    assert out[1].shape == (L, N, H)
+    assert out[2].shape == (L, N, H)
+
+
+def test_fused_vs_unrolled_lstm_consistency():
+    """The reference's central RNN test: FusedRNNCell == its unfuse()
+    (ref: test_rnn.py fused vs cell consistency)."""
+    T, N, C, H = 4, 2, 3, 5
+    fused = FusedRNNCell(H, num_layers=1, mode="lstm", prefix="lstm_",
+                         get_next_state=True)
+    data = sym.Variable("data")  # (N, T, C) NTC
+    f_out, f_states = fused.unroll(T, inputs=data, layout="NTC",
+                                   merge_outputs=True)
+    f_net = f_out
+
+    unfused = fused.unfuse()
+    u_out, u_states = unfused.unroll(
+        T, inputs=sym.Variable("data"), layout="NTC", merge_outputs=True)
+
+    x = np.random.uniform(-1, 1, (N, T, C)).astype(np.float32)
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    psize = rnn_param_size("lstm", C, H, 1, False)
+    flat = np.random.uniform(-0.2, 0.2, psize).astype(np.float32)
+
+    # fused executor
+    f_args = {"data": nd.array(x), "lstm_parameters": nd.array(flat),
+              "lstm_begin_state_0": nd.zeros((1, N, H)),
+              "lstm_begin_state_1": nd.zeros((1, N, H))}
+    f_ex = f_net.bind(mx.cpu(), f_args)
+    f_ex.forward()
+    fused_out = f_ex.outputs[0].asnumpy()
+
+    # unfused executor with unpacked weights
+    unpacked = fused.unpack_weights({"lstm_parameters": nd.array(flat)})
+    u_args = {"data": nd.array(x)}
+    u_args.update(unpacked)
+    u_arg_names = sym.Group(u_out if isinstance(u_out, list) else [u_out]
+                            ).list_arguments()
+    for name in u_arg_names:
+        if "begin_state" in name:
+            u_args[name] = nd.zeros((N, H))
+    u_args = {k: v for k, v in u_args.items() if k in u_arg_names}
+    u_ex = u_out.bind(mx.cpu(), u_args)
+    u_ex.forward()
+    unfused_out = u_ex.outputs[0].asnumpy()
+    assert fused_out.shape == unfused_out.shape
+    assert np.allclose(fused_out, unfused_out, rtol=1e-3, atol=1e-5), \
+        np.abs(fused_out - unfused_out).max()
+
+
+def test_bidirectional_cell():
+    cell = BidirectionalCell(LSTMCell(4, prefix="l_"),
+                             LSTMCell(4, prefix="r_"))
+    outputs, states = cell.unroll(3, inputs=[sym.Variable("t%d" % i)
+                                             for i in range(3)])
+    net = sym.Group(outputs)
+    shapes = {"t%d" % i: (2, 5) for i in range(3)}
+    shapes.update({"l_begin_state_0": (2, 4), "l_begin_state_1": (2, 4),
+                   "r_begin_state_0": (2, 4), "r_begin_state_1": (2, 4)})
+    _, outs, _ = net.infer_shape(**shapes)
+    assert outs == [(2, 8)] * 3  # concat of both directions
+
+
+def test_sequential_stack():
+    stack = SequentialRNNCell()
+    stack.add(LSTMCell(8, prefix="l0_"))
+    stack.add(DropoutCell(0.5, prefix="d0_"))
+    stack.add(LSTMCell(8, prefix="l1_"))
+    outputs, states = stack.unroll(2, inputs=[sym.Variable("t0"),
+                                              sym.Variable("t1")])
+    assert len(states) == 4  # two LSTM cells x (h, c)
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [4, 5], [1, 2, 3, 4, 5, 6], [7, 8], [1, 2],
+                 [3, 4], [5, 6], [7, 8, 9]]
+    it = BucketSentenceIter(sentences, batch_size=2, buckets=[3, 7],
+                            invalid_label=0, layout="NT")
+    assert it.default_bucket_key == 7
+    batches = list(it)
+    assert len(batches) >= 2
+    for b in batches:
+        assert b.bucket_key in (3, 7)
+        assert b.data[0].shape == (2, b.bucket_key)
+
+
+def test_encode_sentences():
+    sents = [["a", "b"], ["b", "c"]]
+    coded, vocab = encode_sentences(sents, invalid_label=0, start_label=1)
+    assert len(vocab) >= 3
+    assert coded[0][1] == coded[1][0]  # same token -> same id
