@@ -45,3 +45,12 @@ from . import parallel
 from . import models
 from . import train_step
 from .train_step import TrainStep
+from . import operator   # registers the Custom op type
+from . import c_api
+from . import rtc
+from . import kvstore_server
+from . import predictor
+from .predictor import Predictor
+# refresh op-function namespaces so late registrations (Custom) appear
+ndarray._init_ndarray_module()
+symbol._init_symbol_module()

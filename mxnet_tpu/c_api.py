@@ -1,0 +1,347 @@
+"""Flat C-API-shaped surface for language bindings.
+
+The reference exposes 114 ``extern "C" MX*`` functions
+(ref: include/mxnet/c_api.h, src/c_api/*.cc) that every binding (R/Scala/
+Perl/C++/Matlab — SURVEY.md §2.7) consumes: opaque handles + flat functions
+returning an int status, with ``MXGetLastError`` for messages.
+
+This module reproduces that contract over the Python substrate: integer
+handles into a registry, the same function names/argument orders, status-code
+returns. It is directly usable via cffi's ``embedding`` or any FFI that can
+call into CPython; a compiled ``libmxnet_tpu`` shim that exports these as
+real C symbols (CPython C API) is the bindings-stage follow-up.
+
+Only the error contract differs internally: exceptions are caught and stored
+for MXGetLastError, exactly like c_api_common.h's error ring.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym
+from . import kvstore as kvs
+from . import random as _random
+from .base import MXNetError
+from .executor import Executor
+from .ndarray import NDArray
+
+_state = threading.local()
+_handles = {}
+_next_handle = [1]
+_lock = threading.Lock()
+
+
+def _new_handle(obj):
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def _get(h):
+    return _handles[h]
+
+
+def _free(h):
+    _handles.pop(h, None)
+
+
+def _capi(fn):
+    """Wrap: return 0 on success, -1 + stored error on exception
+    (ref: API_BEGIN/API_END macros, c_api_common.h)."""
+    def wrapped(*args, **kwargs):
+        try:
+            return 0, fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - the C API flattens all errors
+            _state.error = "%s: %s" % (type(e).__name__, e)
+            return -1, None
+    wrapped.__name__ = fn.__name__
+    return wrapped
+
+
+def MXGetLastError():
+    return getattr(_state, "error", "")
+
+
+@_capi
+def MXGetVersion():
+    from .base import (MXNET_TPU_MAJOR, MXNET_TPU_MINOR, MXNET_TPU_PATCH)
+    return MXNET_TPU_MAJOR * 10000 + MXNET_TPU_MINOR * 100 + MXNET_TPU_PATCH
+
+
+@_capi
+def MXRandomSeed(seed):
+    _random.seed(seed)
+
+
+@_capi
+def MXNotifyShutdown():
+    from . import engine
+    engine.wait_all()
+
+
+# -- NDArray ---------------------------------------------------------------
+
+@_capi
+def MXNDArrayCreate(shape, dev_type, dev_id, delay_alloc=0, dtype="float32"):
+    from .context import Context
+    ctx = Context(Context.devtype2str[dev_type], dev_id)
+    return _new_handle(nd.zeros(tuple(shape), ctx=ctx, dtype=dtype))
+
+
+@_capi
+def MXNDArrayCreateFromNumpy(arr):
+    return _new_handle(nd.array(np.asarray(arr)))
+
+
+@_capi
+def MXNDArrayFree(handle):
+    _free(handle)
+
+
+@_capi
+def MXNDArrayGetShape(handle):
+    return _get(handle).shape
+
+
+@_capi
+def MXNDArrayGetDType(handle):
+    return str(_get(handle).dtype)
+
+
+@_capi
+def MXNDArrayGetContext(handle):
+    ctx = _get(handle).context
+    return (ctx.device_typeid, ctx.device_id)
+
+
+@_capi
+def MXNDArraySyncCopyToCPU(handle):
+    return _get(handle).asnumpy()
+
+
+@_capi
+def MXNDArraySyncCopyFromCPU(handle, arr):
+    _get(handle)[:] = np.asarray(arr)
+
+
+@_capi
+def MXNDArrayWaitToRead(handle):
+    _get(handle).wait_to_read()
+
+
+@_capi
+def MXNDArrayWaitAll():
+    nd.waitall()
+
+
+@_capi
+def MXNDArraySlice(handle, begin, end):
+    return _new_handle(_get(handle)[begin:end])
+
+
+@_capi
+def MXNDArrayReshape(handle, shape):
+    return _new_handle(_get(handle).reshape(tuple(shape)))
+
+
+@_capi
+def MXNDArraySave(fname, handles, keys=None):
+    arrays = [_get(h) for h in handles]
+    if keys:
+        nd.save(fname, dict(zip(keys, arrays)))
+    else:
+        nd.save(fname, arrays)
+
+
+@_capi
+def MXNDArrayLoad(fname):
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        return [_new_handle(data[k]) for k in keys], keys
+    return [_new_handle(a) for a in data], []
+
+
+# -- operator invocation ----------------------------------------------------
+
+@_capi
+def MXListAllOpNames():
+    from .ops import list_ops
+    return list_ops()
+
+
+@_capi
+def MXImperativeInvoke(op_name, input_handles, attrs):
+    from .ops import get as get_op
+    from .ndarray import invoke
+    opdef = get_op(op_name)
+    inputs = [_get(h) for h in input_handles]
+    out = invoke(opdef, inputs, dict(attrs or {}))
+    outs = out if isinstance(out, list) else [out]
+    return [_new_handle(o) for o in outs]
+
+
+# -- Symbol ----------------------------------------------------------------
+
+@_capi
+def MXSymbolCreateVariable(name):
+    return _new_handle(sym.Variable(name))
+
+
+@_capi
+def MXSymbolCreateAtomicSymbol(op_name, keys, vals):
+    attrs = dict(zip(keys, vals))
+    name = attrs.pop("name", None)
+    return _new_handle((op_name, attrs, name))  # composed at MXSymbolCompose
+
+
+@_capi
+def MXSymbolCompose(handle, name, arg_handles, arg_keys=None):
+    spec = _get(handle)
+    if isinstance(spec, tuple):
+        op_name, attrs, aname = spec
+        args = [_get(h) for h in arg_handles]
+        if arg_keys:
+            kwargs = dict(zip(arg_keys, args))
+            kwargs.update(attrs)
+            result = getattr(sym, op_name)(name=name or aname, **kwargs)
+        else:
+            result = getattr(sym, op_name)(*args, name=name or aname, **attrs)
+        _handles[handle] = result
+        return handle
+    raise MXNetError("MXSymbolCompose: handle is already composed")
+
+
+@_capi
+def MXSymbolCreateFromJSON(json_str):
+    return _new_handle(sym.load_json(json_str))
+
+
+@_capi
+def MXSymbolSaveToJSON(handle):
+    return _get(handle).tojson()
+
+
+@_capi
+def MXSymbolListArguments(handle):
+    return _get(handle).list_arguments()
+
+
+@_capi
+def MXSymbolListOutputs(handle):
+    return _get(handle).list_outputs()
+
+
+@_capi
+def MXSymbolListAuxiliaryStates(handle):
+    return _get(handle).list_auxiliary_states()
+
+
+@_capi
+def MXSymbolInferShape(handle, keys, shapes):
+    s = _get(handle)
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(
+        **dict(zip(keys, shapes)))
+    return arg_shapes, out_shapes, aux_shapes
+
+
+@_capi
+def MXSymbolGetInternals(handle):
+    return _new_handle(_get(handle).get_internals())
+
+
+@_capi
+def MXSymbolFree(handle):
+    _free(handle)
+
+
+# -- Executor --------------------------------------------------------------
+
+@_capi
+def MXExecutorBind(sym_handle, dev_type, dev_id, arg_handles,
+                   grad_handles=None, grad_reqs="write", aux_handles=None):
+    from .context import Context
+    ctx = Context(Context.devtype2str[dev_type], dev_id)
+    s = _get(sym_handle)
+    args = [_get(h) for h in arg_handles]
+    grads = [_get(h) if h else None for h in (grad_handles or [])] or None
+    auxs = [_get(h) for h in (aux_handles or [])] or None
+    ex = s.bind(ctx, args, grads, grad_reqs, auxs)
+    return _new_handle(ex)
+
+
+@_capi
+def MXExecutorForward(handle, is_train):
+    _get(handle).forward(is_train=bool(is_train))
+
+
+@_capi
+def MXExecutorBackward(handle, out_grad_handles=None):
+    grads = ([_get(h) for h in out_grad_handles]
+             if out_grad_handles else None)
+    _get(handle).backward(grads)
+
+
+@_capi
+def MXExecutorOutputs(handle):
+    return [_new_handle(o) for o in _get(handle).outputs]
+
+
+@_capi
+def MXExecutorFree(handle):
+    _free(handle)
+
+
+# -- KVStore ---------------------------------------------------------------
+
+@_capi
+def MXKVStoreCreate(kv_type):
+    return _new_handle(kvs.create(kv_type))
+
+
+@_capi
+def MXKVStoreInit(handle, keys, value_handles):
+    _get(handle).init(list(keys), [_get(h) for h in value_handles])
+
+
+@_capi
+def MXKVStorePush(handle, keys, value_handles, priority=0):
+    _get(handle).push(list(keys), [_get(h) for h in value_handles],
+                      priority=priority)
+
+
+@_capi
+def MXKVStorePull(handle, keys, out_handles, priority=0):
+    _get(handle).pull(list(keys), out=[_get(h) for h in out_handles],
+                      priority=priority)
+
+
+@_capi
+def MXKVStoreGetRank(handle):
+    return _get(handle).rank
+
+
+@_capi
+def MXKVStoreGetGroupSize(handle):
+    return _get(handle).num_workers
+
+
+@_capi
+def MXKVStoreBarrier(handle):
+    _get(handle).barrier()
+
+
+@_capi
+def MXKVStoreFree(handle):
+    _free(handle)
+
+
+@_capi
+def MXKVStoreGetNumDeadNode(handle, node_id, timeout_sec=60):
+    return _get(handle).num_dead_node(node_id, timeout_sec)

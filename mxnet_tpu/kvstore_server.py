@@ -1,0 +1,43 @@
+"""KVStore server entry (ref: python/mxnet/kvstore_server.py:11-58).
+
+The reference branches on DMLC_ROLE: 'server' processes block in RunServer
+applying pickled optimizers to pushed gradients; 'worker' processes continue
+into user code. The TPU substrate has no server role — every process is an
+SPMD worker and aggregation happens in-step (psum over ICI). This module
+keeps the entry point so launch scripts that import it keep working, and
+documents the role collapse.
+"""
+from __future__ import annotations
+
+import os
+
+
+def _init_distributed():
+    """Initialize the jax.distributed control plane from MXTPU_* env vars
+    (set by tools/launch.py — the tracker-rendezvous replacement)."""
+    coord = os.environ.get("MXTPU_COORD")
+    if not coord:
+        return False
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ.get("MXTPU_NPROC", "1")),
+        process_id=int(os.environ.get("MXTPU_RANK", "0")))
+    return True
+
+
+def _init_kvstore_server_module():
+    """ref entry point: in the reference this blocks server processes.
+    Here it initializes the distributed control plane (if launched via
+    tools/launch.py) and returns — there are no server processes to block."""
+    role = os.environ.get("DMLC_ROLE", os.environ.get("MXTPU_ROLE", "worker"))
+    if role == "server":
+        raise RuntimeError(
+            "parameter-server roles do not exist on the TPU substrate: all "
+            "processes are SPMD workers and gradient aggregation is an "
+            "in-step psum (see mxnet_tpu.kvstore docs). Launch every process "
+            "as a worker.")
+    _init_distributed()
+
+
+init = _init_kvstore_server_module

@@ -1,0 +1,284 @@
+"""Custom operators defined in Python (ref: python/mxnet/operator.py, 855 LoC;
+C++ bridge src/operator/custom/custom.cc).
+
+API parity: subclass ``CustomOp`` (forward/backward with req/assign),
+describe it with a ``CustomOpProp`` (list_arguments/list_outputs/infer_shape/
+create_operator), register with ``@mx.operator.register("name")``, and use
+``mx.sym.Custom(..., op_type="name")`` / ``mx.nd.Custom(...)``.
+
+Substrate: the reference calls back into Python through ctypes function
+pointers from the engine (custom.cc, exec_type kAsync). Here the callback is
+``jax.pure_callback`` — the Python forward/backward run host-side on numpy
+arrays while staying embeddable inside jit-traced graphs; the backward is
+wired through ``jax.custom_vjp``. Legacy NumpyOp/NDArrayOp are thin aliases.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import OpDef, register_def
+
+_CUSTOM_PROPS = {}
+
+
+def register(reg_name):
+    """Decorator: register a CustomOpProp subclass under ``reg_name``."""
+    def do_register(prop_cls):
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_prop_cls(name):
+    if name not in _CUSTOM_PROPS:
+        raise MXNetError("custom op type %r is not registered" % name)
+    return _CUSTOM_PROPS[name]
+
+
+class CustomOp(object):
+    """Base class for custom operator implementations."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the req (ref: operator.py)."""
+        if req == "null":
+            return
+        elif req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] += src
+
+
+class CustomOpProp(object):
+    """Operator description (ref: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+class _HostArray(object):
+    """Numpy array dressed as an NDArray for CustomOp forward/backward
+    (the reference hands NDArrays; user code reads .asnumpy() / writes
+    slices)."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def asnumpy(self):
+        return self.arr
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+    def __getitem__(self, k):
+        return self.arr[k]
+
+    def __setitem__(self, k, v):
+        self.arr[k] = np.asarray(v)
+
+
+def _instantiate(attrs):
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op requires op_type attr")
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    prop = get_prop_cls(op_type)(**kwargs)
+    return prop
+
+
+def _custom_inputs(attrs):
+    return list(_instantiate(attrs).list_arguments())
+
+
+def _custom_outputs(attrs):
+    return list(_instantiate(attrs).list_outputs())
+
+
+def _custom_infer(attrs, in_shapes):
+    prop = _instantiate(attrs)
+    if any(s is None for s in in_shapes):
+        raise MXNetError("Custom op %s: all input shapes required"
+                         % attrs.get("op_type"))
+    in_s, out_s, aux_s = prop.infer_shape([list(s) for s in in_shapes])
+    return ([tuple(s) for s in in_s], [tuple(s) for s in out_s],
+            [tuple(s) for s in aux_s])
+
+
+def _custom_fn(op_ctx, attrs, inputs, aux):
+    prop = _instantiate(attrs)
+    in_shapes = [tuple(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    out_dtypes = [inputs[0].dtype] * len(out_shapes)
+    op = prop.create_operator(None, in_shapes,
+                              [x.dtype for x in inputs])
+    is_train = bool(op_ctx.is_train)
+    n_out = len(out_shapes)
+
+    def host_forward(*arrs):
+        in_data = [_HostArray(np.array(a)) for a in arrs]
+        out_data = [_HostArray(np.zeros(s, d))
+                    for s, d in zip(out_shapes, out_dtypes)]
+        op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+        return tuple(o.arr for o in out_data)
+
+    result_shapes = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                          for s, d in zip(out_shapes, out_dtypes))
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(host_forward, result_shapes, *xs)
+
+    def fwd(*xs):
+        outs = jax.pure_callback(host_forward, result_shapes, *xs)
+        return outs, (xs, outs)
+
+    def bwd(res, gs):
+        xs, outs = res
+
+        def host_backward(*arrs):
+            k = len(gs)
+            out_grad = [_HostArray(np.array(a)) for a in arrs[:k]]
+            in_data = [_HostArray(np.array(a))
+                       for a in arrs[k:k + len(xs)]]
+            out_data = [_HostArray(np.array(a)) for a in arrs[k + len(xs):]]
+            in_grad = [_HostArray(np.zeros(x.shape, x.dtype)) for x in xs]
+            op.backward(["write"] * len(xs), out_grad, in_data, out_data,
+                        in_grad, [])
+            return tuple(g.arr for g in in_grad)
+
+        grad_shapes = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                            for x in xs)
+        grads = jax.pure_callback(host_backward, grad_shapes,
+                                  *(tuple(gs) + tuple(xs) + tuple(outs)))
+        return tuple(grads)
+
+    run.defvjp(fwd, bwd)
+    return tuple(run(*inputs))
+
+
+_CUSTOM = register_def(OpDef("Custom", _custom_fn, inputs=("data",),
+                             infer_shape=_custom_infer))
+_CUSTOM.list_inputs = _custom_inputs
+_CUSTOM.list_outputs = _custom_outputs
+
+
+# ---------------------------------------------------------------------------
+# legacy python-op APIs (ref: operator.py NumpyOp/NDArrayOp) — thin wrappers
+# ---------------------------------------------------------------------------
+
+class PythonOp(object):
+    """Base legacy op: subclass with forward/backward/infer_shape/
+    list_arguments/list_outputs, then call get_symbol (ref: operator.py)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+        self._counter = [0]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError()
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError()
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def get_symbol(self, *args, **kwargs):
+        op_self = self
+        reg_name = "_legacy_%s_%d" % (type(self).__name__, id(self))
+
+        class _Shim(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                op_self.forward([x.asnumpy() for x in in_data],
+                                [x.arr for x in out_data])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                op_self.backward([g.asnumpy() for g in out_grad],
+                                 [x.asnumpy() for x in in_data],
+                                 [x.asnumpy() for x in out_data],
+                                 [g.arr for g in in_grad])
+
+        class _ShimProp(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=op_self.need_top_grad())
+
+            def list_arguments(self):
+                return op_self.list_arguments()
+
+            def list_outputs(self):
+                return op_self.list_outputs()
+
+            def infer_shape(self, in_shape):
+                res = op_self.infer_shape(in_shape)
+                if len(res) == 2:
+                    return res[0], res[1], []
+                return res
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                return _Shim()
+
+        register(reg_name)(lambda **kw: _ShimProp())
+        from . import symbol as sym
+        kwargs["op_type"] = reg_name
+        return sym.Custom(*args, **kwargs)
+
+
+NumpyOp = PythonOp
+NDArrayOp = PythonOp

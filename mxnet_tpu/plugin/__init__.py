@@ -1,0 +1,10 @@
+"""Plugins (ref: plugin/{torch,caffe,warpctc,opencv,sframe} — SURVEY.md §2.7).
+
+- torch: embed PyTorch modules as operators (ref: plugin/torch TorchModule) —
+  see mxnet_tpu.plugin.torch_module.
+- warpctc: the CTC loss is first-class contrib here (mx.sym.CTCLoss).
+- opencv: image ops live in mxnet_tpu.image (Pillow-backed).
+- caffe/sframe: not reproduced — Caffe-era interop with no TPU users;
+  documented gap rather than a stub that pretends to work.
+"""
+from . import torch_module  # noqa: F401

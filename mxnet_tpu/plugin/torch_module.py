@@ -1,0 +1,151 @@
+"""Torch plugin: run PyTorch modules as operators
+(ref: plugin/torch/torch_module.cc TorchModule/TorchCriterion, which embedded
+Lua Torch layers; here the embed target is PyTorch-CPU via the CustomOp
+host-callback path).
+
+Example::
+
+    import torch.nn as tnn
+    op = TorchModule(tnn.Linear(4, 3))
+    y = op(mx.nd.ones((2, 4)))          # imperative
+    s = op.get_symbol(mx.sym.Variable("data"))   # symbolic, differentiable
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import operator as mxop
+
+_TORCH_COUNTER = [0]
+
+
+class TorchModule(object):
+    """Wrap a torch.nn.Module as an operator. Parameters live inside the
+    torch module (host-side); gradients flow through to the mxnet graph
+    inputs via torch autograd inside the callback."""
+
+    def __init__(self, module):
+        try:
+            import torch  # noqa: F401
+        except ImportError as e:
+            raise MXNetError("TorchModule requires torch: %s" % e)
+        self.module = module
+        _TORCH_COUNTER[0] += 1
+        self._reg_name = "_torch_module_%d" % _TORCH_COUNTER[0]
+        self._register()
+
+    def _register(self):
+        import torch
+        mod = self.module
+
+        class _TorchOp(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = torch.from_numpy(np.ascontiguousarray(
+                    in_data[0].asnumpy()))
+                with torch.no_grad():
+                    y = mod(x)
+                self.assign(out_data[0], req[0], y.numpy())
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                x = torch.from_numpy(np.ascontiguousarray(
+                    in_data[0].asnumpy())).requires_grad_(True)
+                y = mod(x)
+                g = torch.from_numpy(np.ascontiguousarray(
+                    out_grad[0].asnumpy()))
+                y.backward(g)
+                self.assign(in_grad[0], req[0], x.grad.numpy())
+
+        class _TorchProp(mxop.CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=True)
+
+            def list_arguments(self):
+                return ["data"]
+
+            def list_outputs(self):
+                return ["output"]
+
+            def infer_shape(self, in_shape):
+                x = torch.zeros(*in_shape[0])
+                with torch.no_grad():
+                    y = mod(x)
+                return in_shape, [list(y.shape)], []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _TorchOp()
+
+        mxop.register(self._reg_name)(lambda **kw: _TorchProp())
+
+    def __call__(self, x):
+        from .. import ndarray as nd
+        return nd.Custom(x, op_type=self._reg_name)
+
+    def get_symbol(self, data, name=None):
+        from .. import symbol as sym
+        return sym.Custom(data=data, op_type=self._reg_name, name=name)
+
+
+class TorchCriterion(object):
+    """Wrap a torch loss (ref: TorchCriterion): forward computes the loss,
+    backward emits d(loss)/d(input) like the reference loss layers."""
+
+    def __init__(self, criterion):
+        try:
+            import torch  # noqa: F401
+        except ImportError as e:
+            raise MXNetError("TorchCriterion requires torch: %s" % e)
+        self.criterion = criterion
+        _TORCH_COUNTER[0] += 1
+        self._reg_name = "_torch_criterion_%d" % _TORCH_COUNTER[0]
+        self._register()
+
+    def _register(self):
+        import torch
+        crit = self.criterion
+
+        class _CritOp(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = torch.from_numpy(np.ascontiguousarray(
+                    in_data[0].asnumpy()))
+                t = torch.from_numpy(np.ascontiguousarray(
+                    in_data[1].asnumpy()))
+                with torch.no_grad():
+                    loss = crit(x, t)
+                self.assign(out_data[0], req[0],
+                            np.asarray([float(loss)], np.float32))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                x = torch.from_numpy(np.ascontiguousarray(
+                    in_data[0].asnumpy())).requires_grad_(True)
+                t = torch.from_numpy(np.ascontiguousarray(
+                    in_data[1].asnumpy()))
+                loss = crit(x, t)
+                loss.backward()
+                self.assign(in_grad[0], req[0], x.grad.numpy())
+                self.assign(in_grad[1], req[1],
+                            np.zeros_like(in_data[1].asnumpy()))
+
+        class _CritProp(mxop.CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=False)
+
+            def list_arguments(self):
+                return ["data", "label"]
+
+            def list_outputs(self):
+                return ["loss"]
+
+            def infer_shape(self, in_shape):
+                return in_shape, [[1]], []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _CritOp()
+
+        mxop.register(self._reg_name)(lambda **kw: _CritProp())
+
+    def __call__(self, data, label):
+        from .. import ndarray as nd
+        return nd.Custom(data, label, op_type=self._reg_name)

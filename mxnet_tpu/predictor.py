@@ -1,0 +1,77 @@
+"""Standalone predictor (ref: include/mxnet/c_predict_api.h +
+src/c_api/c_predict_api.cc, 334 LoC; amalgamation's MXNET_PREDICT_ONLY build).
+
+Inference-only API over a saved checkpoint: load symbol JSON + params, bind
+once, ``forward`` repeatedly. The reference ships this as a separate minimal
+C API for mobile/embedded; here it is a thin class whose jitted forward is
+the deployable artifact (export via jax.jit / AOT lowering).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym
+from .context import current_context
+
+
+class Predictor(object):
+    def __init__(self, symbol_json_or_file, param_file_or_dict, input_shapes,
+                 ctx=None):
+        ctx = ctx or current_context()
+        if isinstance(symbol_json_or_file, str):
+            if symbol_json_or_file.lstrip().startswith("{"):
+                self._symbol = sym.load_json(symbol_json_or_file)
+            else:
+                self._symbol = sym.load(symbol_json_or_file)
+        else:
+            self._symbol = symbol_json_or_file
+        # strip loss heads for inference when present (ref: c_predict picks
+        # the network output)
+        if isinstance(param_file_or_dict, str):
+            loaded = nd.load(param_file_or_dict)
+        else:
+            loaded = param_file_or_dict
+        arg_params = {}
+        aux_params = {}
+        for k, v in loaded.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
+        arg_names = self._symbol.list_arguments()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                args[name] = nd.zeros(shape)
+        aux = {}
+        for name, shape in zip(self._symbol.list_auxiliary_states(),
+                               aux_shapes):
+            aux[name] = aux_params.get(name, nd.zeros(shape))
+        self._input_names = list(input_shapes.keys())
+        self._executor = self._symbol.bind(ctx, args, aux_states=aux)
+
+    def forward(self, **inputs):
+        feed = {}
+        for k, v in inputs.items():
+            if k not in self._input_names:
+                raise MXNetError("unknown input %r (have %s)"
+                                 % (k, self._input_names))
+            feed[k] = (v if isinstance(v, nd.NDArray)
+                       else nd.array(np.asarray(v)))
+        self._executor.forward(is_train=False, **feed)
+        return self
+
+    def get_output(self, index=0):
+        return self._executor.outputs[index]
+
+    @property
+    def outputs(self):
+        return self._executor.outputs

@@ -13,10 +13,121 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import subprocess
 
 import numpy as np
 
 from .base import MXNetError
+
+# ---------------------------------------------------------------------------
+# native reader (src/io/recordio_reader.cc -> lib/libmxtpu_io.so via ctypes):
+# the C++ data plane with a background prefetch thread (the dmlc::ThreadedIter
+# role, ref: src/io/iter_prefetcher.h:129)
+# ---------------------------------------------------------------------------
+_NATIVE = None
+
+
+def _load_native():
+    global _NATIVE
+    if _NATIVE is not None:
+        return _NATIVE or None
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(root, "lib", "libmxtpu_io.so")
+    if not os.path.exists(so):
+        src = os.path.join(root, "src")
+        if os.path.exists(os.path.join(src, "Makefile")):
+            try:
+                subprocess.run(["make", "-C", src], check=True,
+                               capture_output=True)
+            except Exception:
+                _NATIVE = False
+                return None
+    if not os.path.exists(so):
+        _NATIVE = False
+        return None
+    lib = ctypes.CDLL(so)
+    lib.mxtpu_rio_open.restype = ctypes.c_void_p
+    lib.mxtpu_rio_open.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_rio_next.restype = ctypes.POINTER(ctypes.c_char)
+    lib.mxtpu_rio_next.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxtpu_rio_rewind.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_rio_close.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_rio_build_index.restype = ctypes.c_int64
+    lib.mxtpu_rio_build_index.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_rio_read_at.restype = ctypes.POINTER(ctypes.c_char)
+    lib.mxtpu_rio_read_at.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxtpu_rio_prefetch_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mxtpu_rio_prefetch_next.restype = ctypes.c_int64
+    lib.mxtpu_rio_prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.c_uint64]
+    _NATIVE = lib
+    return lib
+
+
+class NativeRecordIOReader(object):
+    """Sequential/indexed reader backed by the C++ library, with optional
+    background prefetching."""
+
+    def __init__(self, uri, prefetch=False, queue_size=64):
+        lib = _load_native()
+        if lib is None:
+            raise MXNetError("native IO library unavailable "
+                             "(build with make -C src)")
+        self._lib = lib
+        self._h = lib.mxtpu_rio_open(uri.encode())
+        if not self._h:
+            raise MXNetError("cannot open %s" % uri)
+        self._prefetch = prefetch
+        self._cap = 1 << 20
+        self._buf = ctypes.create_string_buffer(self._cap)
+        if prefetch:
+            lib.mxtpu_rio_prefetch_start(self._h, queue_size)
+
+    def read(self):
+        if self._h is None:
+            raise MXNetError("reader closed")
+        if self._prefetch:
+            while True:
+                n = self._lib.mxtpu_rio_prefetch_next(self._h, self._buf,
+                                                      self._cap)
+                if n == -1:  # record larger than buffer: grow and retry
+                    self._cap *= 4
+                    self._buf = ctypes.create_string_buffer(self._cap)
+                    continue
+                if n == -2:  # end of stream
+                    return None
+                return self._buf.raw[:n]
+        ln = ctypes.c_uint64()
+        ptr = self._lib.mxtpu_rio_next(self._h, ctypes.byref(ln))
+        if not ptr or ln.value == 0:
+            return None if not ptr else b""
+        return ctypes.string_at(ptr, ln.value)
+
+    def build_index(self):
+        return int(self._lib.mxtpu_rio_build_index(self._h))
+
+    def read_at(self, i):
+        ln = ctypes.c_uint64()
+        ptr = self._lib.mxtpu_rio_read_at(self._h, i, ctypes.byref(ln))
+        if not ptr:
+            return None
+        return ctypes.string_at(ptr, ln.value)
+
+    def reset(self):
+        self._lib.mxtpu_rio_rewind(self._h)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.mxtpu_rio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 _MAGIC = 0xced7230a
 _KMAGIC_STRUCT = struct.Struct("<I")

@@ -1,0 +1,167 @@
+"""CustomOp + C API tests (ref strategy: test_operator.py custom-op section;
+binding contract from include/mxnet/c_api.h)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+import mxnet_tpu.operator as mxop
+from mxnet_tpu import c_api
+
+
+@mxop.register("sqr")
+class SqrProp(mxop.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mxop.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], x * x)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g = out_grad[0].asnumpy()
+        x = in_data[0].asnumpy()
+        self.assign(in_grad[0], req[0], 2 * x * g)
+
+
+def test_custom_op_imperative():
+    x = nd.array(np.array([1.0, 2.0, 3.0]))
+    y = mx.nd.Custom(x, op_type="sqr")
+    assert np.allclose(y.asnumpy(), [1, 4, 9])
+
+
+def test_custom_op_symbolic_forward_backward():
+    data = sym.Variable("data")
+    s = sym.Custom(data=data, op_type="sqr", name="sqr0")
+    assert s.list_arguments() == ["data"]
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    ag = nd.zeros((3,))
+    ex = s.bind(mx.cpu(), {"data": nd.array(x)}, args_grad={"data": ag})
+    ex.forward(is_train=True)
+    assert np.allclose(ex.outputs[0].asnumpy(), x * x)
+    ex.backward(out_grads=nd.ones((3,)))
+    assert np.allclose(ag.asnumpy(), 2 * x)
+
+
+def test_custom_op_in_graph():
+    # custom op composed with builtin ops, differentiated end to end
+    data = sym.Variable("data")
+    s = sym.sum(data=sym.Custom(data=data * 2, op_type="sqr"))
+    x = np.array([1.0, 2.0], np.float32)
+    ag = nd.zeros((2,))
+    ex = s.bind(mx.cpu(), {"data": nd.array(x)}, args_grad={"data": ag})
+    ex.forward(is_train=True)
+    assert np.allclose(ex.outputs[0].asnumpy(), np.sum((2 * x) ** 2))
+    ex.backward(out_grads=nd.ones(()))
+    assert np.allclose(ag.asnumpy(), 8 * x)  # d/dx (2x)^2 = 8x
+
+
+def test_custom_op_infer_shape():
+    data = sym.Variable("data")
+    s = sym.Custom(data=data, op_type="sqr")
+    _, out_shapes, _ = s.infer_shape(data=(4, 5))
+    assert out_shapes == [(4, 5)]
+
+
+def test_legacy_python_op():
+    class Plus3(mxop.PythonOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] + 3
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0]
+
+    op = Plus3()
+    s = op.get_symbol(sym.Variable("data"))
+    ex = s.bind(mx.cpu(), {"data": nd.ones((2,))})
+    ex.forward()
+    assert np.allclose(ex.outputs[0].asnumpy(), 4.0)
+
+
+# -- C API -----------------------------------------------------------------
+
+def test_capi_ndarray_roundtrip():
+    code, h = c_api.MXNDArrayCreate((2, 3), 1, 0)
+    assert code == 0
+    code, _ = c_api.MXNDArraySyncCopyFromCPU(h, np.ones((2, 3), np.float32))
+    assert code == 0
+    code, arr = c_api.MXNDArraySyncCopyToCPU(h)
+    assert code == 0 and (arr == 1).all()
+    code, shape = c_api.MXNDArrayGetShape(h)
+    assert shape == (2, 3)
+    c_api.MXNDArrayFree(h)
+
+
+def test_capi_error_contract():
+    code, _ = c_api.MXNDArrayGetShape(99999999)  # bad handle
+    assert code == -1
+    assert "KeyError" in c_api.MXGetLastError()
+
+
+def test_capi_imperative_invoke():
+    code, h = c_api.MXNDArrayCreateFromNumpy(np.array([1.0, 2.0], np.float32))
+    code, outs = c_api.MXImperativeInvoke("sqrt", [h], {})
+    assert code == 0
+    code, arr = c_api.MXNDArraySyncCopyToCPU(outs[0])
+    assert np.allclose(arr, np.sqrt([1.0, 2.0]))
+
+
+def test_capi_symbol_and_executor():
+    code, v = c_api.MXSymbolCreateVariable("data")
+    code, s = c_api.MXSymbolCreateAtomicSymbol(
+        "FullyConnected", ["num_hidden"], [4])
+    code, s = c_api.MXSymbolCompose(s, "fc", [v], ["data"])
+    assert code == 0
+    code, args = c_api.MXSymbolListArguments(s)
+    assert args == ["data", "fc_weight", "fc_bias"]
+    code, (arg_shapes, out_shapes, _) = c_api.MXSymbolInferShape(
+        s, ["data"], [(2, 3)])
+    assert out_shapes == [(2, 4)]
+    handles = []
+    for sh in arg_shapes:
+        _, h = c_api.MXNDArrayCreate(sh, 1, 0)
+        c_api.MXNDArraySyncCopyFromCPU(
+            h, np.ones(sh, np.float32) * 0.1)
+        handles.append(h)
+    code, ex = c_api.MXExecutorBind(s, 1, 0, handles)
+    assert code == 0
+    code, _ = c_api.MXExecutorForward(ex, 0)
+    assert code == 0
+    code, outs = c_api.MXExecutorOutputs(ex)
+    code, arr = c_api.MXNDArraySyncCopyToCPU(outs[0])
+    assert arr.shape == (2, 4)
+
+
+def test_capi_kvstore():
+    code, kv = c_api.MXKVStoreCreate("local")
+    _, h = c_api.MXNDArrayCreateFromNumpy(np.zeros(3, np.float32))
+    c_api.MXKVStoreInit(kv, [0], [h])
+    _, g = c_api.MXNDArrayCreateFromNumpy(np.ones(3, np.float32))
+    c_api.MXKVStorePush(kv, [0], [g])
+    _, out = c_api.MXNDArrayCreateFromNumpy(np.zeros(3, np.float32))
+    c_api.MXKVStorePull(kv, [0], [out])
+    _, arr = c_api.MXNDArraySyncCopyToCPU(out)
+    assert (arr == 1).all()
+    code, rank = c_api.MXKVStoreGetRank(kv)
+    assert rank == 0
+
+
+def test_capi_version_and_ops():
+    code, v = c_api.MXGetVersion()
+    assert code == 0 and v >= 100
+    code, ops = c_api.MXListAllOpNames()
+    assert "Convolution" in ops

@@ -82,3 +82,53 @@ def test_csv_iter(tmp_path):
     batches = list(it)
     assert len(batches) == 2
     assert np.allclose(batches[0].data[0].asnumpy(), X[:5], rtol=1e-5)
+
+
+def test_native_recordio_reader(tmp_path):
+    """C++ reader parity with the python writer (src/io/recordio_reader.cc)."""
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "native.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"tail"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    r = recordio.NativeRecordIOReader(path)
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == payloads
+    n = r.build_index()
+    assert n == 4
+    assert r.read_at(1) == payloads[1]
+    assert r.read_at(3) == payloads[3]
+    r.close()
+
+    r2 = recordio.NativeRecordIOReader(path, prefetch=True)
+    got2 = []
+    while True:
+        rec = r2.read()
+        if rec is None:
+            break
+        got2.append(rec)
+    assert got2 == payloads
+    r2.close()
+
+
+def test_recordio_python_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "py.rec")
+    idx = str(tmp_path / "py.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(header, b"payload%d" % i))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    h, payload = recordio.unpack(r.read_idx(3))
+    assert h.label == 3.0
+    assert payload == b"payload3"

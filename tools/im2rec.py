@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO (ref: tools/im2rec.py + the C++
+tools/im2rec.cc binary).
+
+Usage:
+  python tools/im2rec.py prefix image_root --list      # generate .lst
+  python tools/im2rec.py prefix image_root             # pack prefix.lst
+Produces prefix.rec + prefix.idx readable by mxnet_tpu.image.ImageIter.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from mxnet_tpu import recordio
+
+
+def list_images(root, recursive=True, exts=(".jpg", ".jpeg", ".png")):
+    cat = {}
+    out = []
+    i = 0
+    for path, dirs, files in sorted(os.walk(root)):
+        dirs.sort()
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() not in exts:
+                continue
+            label_name = os.path.relpath(path, root)
+            if label_name not in cat:
+                cat[label_name] = len(cat)
+            rel = os.path.relpath(os.path.join(path, fname), root)
+            out.append((i, cat[label_name], rel))
+            i += 1
+        if not recursive:
+            break
+    return out
+
+
+def write_list(prefix, image_list, shuffle=True):
+    if shuffle:
+        random.shuffle(image_list)
+    with open(prefix + ".lst", "w") as f:
+        for idx, label, rel in image_list:
+            f.write("%d\t%f\t%s\n" % (idx, float(label), rel))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            yield int(parts[0]), float(parts[1]), parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0):
+    from PIL import Image
+    import io as _io
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        img = Image.open(path).convert("RGB")
+        if resize:
+            w, h = img.size
+            if w < h:
+                img = img.resize((resize, h * resize // w), Image.BILINEAR)
+            else:
+                img = img.resize((w * resize // h, resize), Image.BILINEAR)
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG", quality=quality)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf.getvalue()))
+        n += 1
+    rec.close()
+    print("packed %d images into %s.rec" % (n, prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prefix")
+    parser.add_argument("root")
+    parser.add_argument("--list", action="store_true",
+                        help="generate the .lst file instead of packing")
+    parser.add_argument("--no-shuffle", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--resize", type=int, default=0)
+    args = parser.parse_args()
+    if args.list:
+        write_list(args.prefix, list_images(args.root),
+                   shuffle=not args.no_shuffle)
+    else:
+        pack(args.prefix, args.root, args.quality, args.resize)
+
+
+if __name__ == "__main__":
+    main()

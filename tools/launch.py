@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Multi-host launcher (ref: tools/launch.py over dmlc-core trackers —
+local/ssh/mpi/sge/yarn, setting DMLC_ROLE/DMLC_PS_ROOT_* per process).
+
+TPU-native: there are no parameter-server roles — every process is a worker
+in one SPMD program; ``jax.distributed.initialize`` replaces the tracker
+rendezvous (coordinator address + process_id + num_processes), and gradient
+sync rides psum over ICI/DCN instead of ps-lite push/pull.
+
+Launchers:
+  local — spawn N worker processes on this host (the reference's local
+          tracker; useful with a CPU mesh for testing dist_sync semantics)
+  ssh   — spawn one worker per host in --host-file via ssh
+
+Each worker gets MXTPU_COORD / MXTPU_RANK / MXTPU_NPROC env vars; call
+``mxnet_tpu.tools_init_distributed()`` (or jax.distributed.initialize
+directly) at program start.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_local(n, command, coord_port=12421):
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(MXTPU_COORD="localhost:%d" % coord_port,
+                   MXTPU_RANK=str(rank), MXTPU_NPROC=str(n),
+                   # workers on one host must split visible devices or run cpu
+                   JAX_PLATFORMS=env.get("JAX_PLATFORMS", ""))
+        procs.append(subprocess.Popen(command, shell=True, env=env))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def launch_ssh(host_file, command, coord_port=12421):
+    with open(host_file) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    coord = "%s:%d" % (hosts[0], coord_port)
+    procs = []
+    for rank, host in enumerate(hosts):
+        env_prefix = ("MXTPU_COORD=%s MXTPU_RANK=%d MXTPU_NPROC=%d"
+                      % (coord, rank, len(hosts)))
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host,
+             "cd %s && %s %s" % (os.getcwd(), env_prefix, command)]))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", choices=["local", "ssh"],
+                        default="local")
+    parser.add_argument("--host-file", default=None)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    command = " ".join(args.command)
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, command))
+    else:
+        assert args.host_file, "ssh launcher needs --host-file"
+        sys.exit(launch_ssh(args.host_file, command))
+
+
+if __name__ == "__main__":
+    main()
