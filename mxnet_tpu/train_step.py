@@ -60,8 +60,15 @@ class TrainStep(object):
         self.mesh = mesh
         self.param_shardings = dict(param_shardings or {})
         self.dtype = np.dtype(dtype)
-        self.compute_dtype = (np.dtype(compute_dtype)
-                              if compute_dtype is not None else None)
+        if compute_dtype is not None:
+            self.compute_dtype = np.dtype(compute_dtype)
+        elif self.dtype != np.dtype(np.float32):
+            # params stored in a non-f32 dtype: batch inputs must be cast to
+            # match (lax.conv requires equal dtypes), so the storage dtype IS
+            # the compute dtype
+            self.compute_dtype = self.dtype
+        else:
+            self.compute_dtype = None
         self._run, self._nodes = _build_graph_runner(symbol)
         self._needs_rng = any((not n.is_variable) and n.op.needs_rng
                               for n in self._nodes)
