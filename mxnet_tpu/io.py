@@ -259,7 +259,12 @@ class PrefetchingIter(DataIter):
 
 
 def _init_data(data, allow_empty, default_name):
-    """Normalize data into list of (name, numpy) (ref: io.py _init_data)."""
+    """Normalize data into list of (name, array) (ref: io.py _init_data).
+
+    NDArray input stays device-backed (jax.Array) so per-batch slicing is an
+    on-device gather — the reference's NDArrayIter likewise keeps mx.nd data
+    wherever the user placed it. numpy input stays host-side.
+    """
     assert data is not None or allow_empty
     if data is None:
         data = []
@@ -278,7 +283,7 @@ def _init_data(data, allow_empty, default_name):
     out = {}
     for k, v in data.items():
         if isinstance(v, NDArray):
-            out[k] = v.asnumpy()
+            out[k] = v.data  # device-resident jax.Array
         else:
             out[k] = np.asarray(v)
     return list(sorted(out.items()))
@@ -348,10 +353,17 @@ class NDArrayIter(DataIter):
         if self.cursor + self.batch_size <= self.num_data:
             return [array(x[1][self.cursor:self.cursor + self.batch_size])
                     for x in data_source]
-        # padding with wrap-around (ref: io.py NDArrayIter _getdata)
+        # padding with wrap-around (ref: io.py NDArrayIter _getdata);
+        # device-backed sources concatenate on-device
         pad = self.batch_size - self.num_data + self.cursor
-        return [array(np.concatenate((x[1][self.cursor:], x[1][:pad]), axis=0))
-                for x in data_source]
+
+        def cat(v):
+            if isinstance(v, np.ndarray):
+                return np.concatenate((v[self.cursor:], v[:pad]), axis=0)
+            import jax.numpy as jnp
+            return jnp.concatenate((v[self.cursor:], v[:pad]), axis=0)
+
+        return [array(cat(x[1])) for x in data_source]
 
     def getdata(self):
         return self._getdata(self.data)

@@ -142,14 +142,21 @@ class BaseModule(object):
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        fused_step = getattr(self, "_try_fused_fit_step", None)
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                # fast path: fwd+bwd+update fused into one donated jit
+                # (falls back to the general executor path when the module
+                # configuration needs it — monitor, dist kvstore, grad_req,
+                # unfused optimizer, bucketing/shared modules)
+                if monitor is not None or fused_step is None \
+                        or not fused_step(data_batch):
+                    self.forward_backward(data_batch)
+                    self.update()
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -68,6 +68,17 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
 
+        # fused fast path (forward+backward+update in ONE donated jit; the
+        # reference's API and its speed were the same thing — model.py:88-117
+        # update_on_kvstore was its fast path, this is ours)
+        self._fused = None
+        self._fused_state = None
+        self._fused_outputs = None
+        self._fused_ok = True
+        self._fused_dirty = False
+        self._fused_params_stale = False
+        self._monitor_installed = False
+
     # -- checkpointing (ref: module.py:97-156, :674-704) ----------------
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -92,6 +103,7 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        self._sync_fused_opt_states()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -143,6 +155,7 @@ class Module(BaseModule):
         return (self._arg_params, self._aux_params)
 
     def _sync_params_from_devices(self):
+        self._sync_fused_to_executor()
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
@@ -184,6 +197,7 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group._replicate_params()
+        self._fused_params_stale = True
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
@@ -197,6 +211,7 @@ class Module(BaseModule):
         self._exec_group.set_params(arg_params, aux_params)
         self._params_dirty = True
         self.params_initialized = True
+        self._fused_params_stale = True
 
     # -- bind -----------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -312,6 +327,9 @@ class Module(BaseModule):
             self._updater = opt.get_updater(optimizer)
 
         self.optimizer_initialized = True
+        self._fused = None
+        self._fused_state = None
+        self._fused_ok = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
@@ -323,10 +341,163 @@ class Module(BaseModule):
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
         self.optimizer_initialized = True
+        # shared/bucketing modules alias parameter storage across executors;
+        # the fused state would break that aliasing — keep the executor path
+        self._fused_ok = False
+        shared_module._fused_ok = False
+        shared_module._sync_fused_to_executor()
+
+    # -- fused fast path ------------------------------------------------
+    def _fused_eligible(self):
+        if not self._fused_ok or self._monitor_installed:
+            return False
+        if self.inputs_need_grad or self._state_names:
+            return False
+        if self._kvstore is not None and "dist" in self._kvstore.type:
+            return False
+        if not getattr(self._optimizer, "fused_supported", False):
+            return False
+        eg = self._exec_group
+        for n in eg.param_names:
+            if eg.grad_req.get(n, "null") not in ("write", "null"):
+                return False
+        return True
+
+    def _build_fused(self):
+        from ..train_step import TrainStep
+        eg = self._exec_group
+        frozen = [n for n in eg.param_names
+                  if eg.grad_req.get(n, "null") == "null"]
+        self._fused = TrainStep(
+            self._symbol, data_names=eg.data_names,
+            label_names=eg.label_names, optimizer=self._optimizer,
+            mesh=eg._mesh, frozen_param_names=frozen)
+        self._fused_state = self._seed_fused_state()
+        self._fused_params_stale = False
+
+    def _jnp_copy(self, x):
+        import jax.numpy as jnp
+        return jnp.copy(x)
+
+    def _seed_fused_state(self, prev=None):
+        """Build the fused state tree from the executor's current arrays
+        (copies: the first step donates the state buffers). ``prev`` keeps
+        optimizer state and step count across a parameter re-seed."""
+        import jax.numpy as jnp
+        ex = self._exec_group.executor
+        params = {n: self._jnp_copy(ex.arg_dict[n].data)
+                  for n in self._fused.param_names}
+        aux = {n: self._jnp_copy(ex.aux_dict[n].data)
+               for n in self._fused.aux_names}
+        if prev is not None:
+            opt_state = prev["opt"]
+            step = prev["step"]
+        else:
+            opt_state = self._fused_opt_state(params)
+            step = jnp.zeros((), jnp.int32)
+        state = {"params": params, "aux": aux, "opt": opt_state,
+                 "step": step}
+        if self._fused.mesh is not None:
+            state = self._fused._shard_state(state)
+        return state
+
+    def _fused_opt_state(self, params):
+        """Optimizer state for the fused tree, seeded from preloaded updater
+        states when present (load_optimizer_states round-trip)."""
+        updater = self._updater
+        if self._update_on_kvstore and self._kvstore is not None:
+            updater = getattr(self._kvstore, "_updater", None)
+        states = dict(getattr(updater, "states", None) or {})
+        idx_of = {n: i for i, n in enumerate(self._exec_group.param_names)}
+
+        def to_jnp(x):
+            if x is None:
+                return None
+            if isinstance(x, tuple):
+                return tuple(to_jnp(i) for i in x)
+            return x.data if hasattr(x, "data") else x
+
+        out = {}
+        for n, v in params.items():
+            if n in self._fused.frozen_param_names:
+                continue
+            idx = idx_of.get(n)
+            if idx is not None and idx in states:
+                out[n] = to_jnp(states[idx])
+            else:
+                out[n] = self._optimizer.create_fused_state(v)
+        return out
+
+    def _try_fused_fit_step(self, data_batch):
+        """fit()'s fast path: one donated jit for fwd+bwd+update. Returns
+        False when the configuration needs the general executor path."""
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            return False
+        if self._fused is None:
+            if not self._fused_eligible():
+                return False
+            self._build_fused()
+        if self._fused_params_stale:
+            self._fused_state = self._seed_fused_state(prev=self._fused_state)
+            self._fused_params_stale = False
+        eg = self._exec_group
+        batch = {}
+        for name, value in zip(eg.data_names, data_batch.data):
+            batch[name] = eg._shard_batch(value)
+        if eg.label_names and data_batch.label:
+            for name, value in zip(eg.label_names, data_batch.label):
+                batch[name] = eg._shard_batch(value)
+        from ..ndarray import NDArray
+        self._fused_state, outs = self._fused.step(self._fused_state, batch)
+        self._fused_outputs = [NDArray(o) for o in outs]
+        self._fused_dirty = True
+        self._params_dirty = True
+        return True
+
+    def _sync_fused_to_executor(self):
+        """Write fused params/aux back into the executor arrays (copies —
+        the next fused step donates the state)."""
+        if not self._fused_dirty or self._fused_state is None:
+            return
+        ex = self._exec_group.executor
+        for n in self._fused.param_names:
+            ex.arg_dict[n]._set_data(
+                self._jnp_copy(self._fused_state["params"][n]))
+        for n in self._fused.aux_names:
+            ex.aux_dict[n]._set_data(
+                self._jnp_copy(self._fused_state["aux"][n]))
+        self._fused_dirty = False
+
+    def _sync_fused_opt_states(self):
+        """Mirror fused optimizer state into the updater's index-keyed dict
+        so save_optimizer_states round-trips."""
+        if self._fused_state is None:
+            return
+        updater = self._updater
+        if self._update_on_kvstore and self._kvstore is not None:
+            updater = getattr(self._kvstore, "_updater", None)
+        if updater is None:
+            return
+        from ..ndarray import NDArray
+
+        def to_nd(x):
+            if x is None:
+                return None
+            if isinstance(x, tuple):
+                return tuple(to_nd(i) for i in x)
+            return NDArray(self._jnp_copy(x))
+
+        idx_of = {n: i for i, n in enumerate(self._exec_group.param_names)}
+        for n, st in self._fused_state["opt"].items():
+            if n in idx_of:
+                updater.states[idx_of[n]] = to_nd(st)
 
     # -- computation ----------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        self._sync_fused_to_executor()
+        self._fused_outputs = None
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -351,6 +522,8 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_outputs is not None:
+            return list(self._fused_outputs)
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -359,8 +532,15 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        self._exec_group.update_metric(eval_metric, labels)
+        if self._fused_outputs is not None:
+            eval_metric.update(labels, self._fused_outputs)
+        else:
+            self._exec_group.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
+        # monitor needs the per-node executor path
+        self._sync_fused_to_executor()
+        self._monitor_installed = True
+        self._fused_ok = False
         mon.install(self._exec_group.executor)

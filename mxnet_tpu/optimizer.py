@@ -67,6 +67,41 @@ class Optimizer(object):
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
+    # -- fused (traceable) path -----------------------------------------
+    # The fused train step (mxnet_tpu.train_step) inlines the optimizer into
+    # the same donated jit as forward/backward — the TPU analog of the
+    # reference's in-graph optimizer update ops
+    # (ref: src/operator/optimizer_op-inl.h). ``fused_update`` is pure jnp:
+    # no NDArray, no host sync. ``grad`` arrives already rescaled (the step
+    # applies rescale_grad uniformly); each optimizer applies clip_gradient
+    # at the point its imperative update does (SGD-family clip the bare
+    # gradient; Adam/RMSProp clip grad+wd*weight). ``lr`` is a traced scalar
+    # (scheduler output), ``wd`` a python float, ``t`` the traced 1-based
+    # update count.
+
+    fused_supported = False
+
+    def _fused_clip(self, g):
+        if self.clip_gradient is None:
+            return g
+        import jax.numpy as jnp
+        return jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+
+    def create_fused_state(self, weight):
+        """jnp state pytree mirroring create_state's structure."""
+        def to_jnp(x):
+            if x is None:
+                return None
+            if isinstance(x, tuple):
+                return tuple(to_jnp(i) for i in x)
+            return x.data if isinstance(x, NDArray) else x
+        return to_jnp(self.create_state(0, NDArray(weight)))
+
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        """Return (new_weight, new_state); pure function of jnp inputs."""
+        raise MXNetError("optimizer %s has no fused update"
+                         % type(self).__name__)
+
     # -- lr / wd multipliers (attr-aware, ref: optimizer.py) ------------
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult = {}
@@ -131,6 +166,8 @@ def create(name, **kwargs):
 class SGD(Optimizer):
     """SGD with momentum, via fused sgd(_mom)_update ops."""
 
+    fused_supported = True
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -151,6 +188,13 @@ class SGD(Optimizer):
         else:
             new_w = nd.sgd_update(weight, grad, **attrs)
             weight._set_data(new_w.data)
+
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        g = self._fused_clip(grad)
+        if state is None:
+            return weight - lr * (g + wd * weight), None
+        m = self.momentum * state - lr * (g + wd * weight)
+        return weight + m, m
 
 
 @register
@@ -174,10 +218,21 @@ class NAG(SGD):
         else:
             weight += -lr * (g + wd * weight)
 
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        g = self._fused_clip(grad)
+        if state is None:
+            return weight - lr * (g + wd * weight), None
+        g = g + wd * weight
+        m = self.momentum * state + g
+        return weight - lr * (g + self.momentum * m), m
+
 
 @register
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (ref: optimizer.py SGLD)."""
+
+    fused_supported = True
+    fused_needs_key = True
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -189,6 +244,14 @@ class SGLD(Optimizer):
         noise = nd.normal(loc=0, scale=math.sqrt(lr), shape=weight.shape)
         weight += -lr / 2 * (g + wd * weight) + noise
 
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        import jax
+        import jax.numpy as jnp
+        g = self._fused_clip(grad)
+        noise = jnp.sqrt(lr) * jax.random.normal(key, weight.shape,
+                                                 weight.dtype)
+        return weight - lr / 2 * (g + wd * weight) + noise, None
+
 
 @register
 class ccSGD(SGD):
@@ -198,6 +261,8 @@ class ccSGD(SGD):
 @register
 class DCASGD(Optimizer):
     """Delay-compensated async SGD (ref: optimizer.py DCASGD)."""
+
+    fused_supported = True
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
@@ -229,6 +294,17 @@ class DCASGD(Optimizer):
         previous_weight[:] = weight
         weight += d
 
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        g = self._fused_clip(grad)
+        mom, prev_w = state
+        comp = g + wd * weight + self.lamda * g * g * (weight - prev_w)
+        if mom is not None:
+            mom = self.momentum * mom - lr * comp
+            d = mom
+        else:
+            d = -lr * comp
+        return weight + d, (mom, weight)
+
 
 @register
 class Adam(Optimizer):
@@ -258,9 +334,24 @@ class Adam(Optimizer):
         mean._set_data(new_mean.data)
         var._set_data(new_var.data)
 
+    fused_supported = True
+
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        import jax.numpy as jnp
+        mean, var = state
+        # ref: Adam adds wd*weight to the grad, then clips the sum
+        g = self._fused_clip(grad + wd * weight)
+        mean = self.beta1 * mean + (1 - self.beta1) * g
+        var = self.beta2 * var + (1 - self.beta2) * g * g
+        lr_t = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        w = weight - lr_t * mean / (jnp.sqrt(var) + self.epsilon)
+        return w, (mean, var)
+
 
 @register
 class AdaGrad(Optimizer):
+    fused_supported = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -280,9 +371,19 @@ class AdaGrad(Optimizer):
         weight += -lr * (g / nd.sqrt(history + self.float_stable_eps)
                          + wd * weight)
 
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        import jax.numpy as jnp
+        g = self._fused_clip(grad)
+        history = state + g * g
+        w = weight - lr * (g / jnp.sqrt(history + self.float_stable_eps)
+                           + wd * weight)
+        return w, history
+
 
 @register
 class RMSProp(Optimizer):
+    fused_supported = True
+
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -320,9 +421,31 @@ class RMSProp(Optimizer):
             g_avg._set_data(new_g.data)
             delta._set_data(new_d.data)
 
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        import jax.numpy as jnp
+        g = self._fused_clip(grad + wd * weight)
+        if not self.centered:
+            (n,) = state
+            n = (1 - self.gamma1) * g * g + self.gamma1 * n
+            w = weight - lr * g / jnp.sqrt(n + self.epsilon)
+            if self.clip_weights:
+                w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+            return w, (n,)
+        n, g_avg, delta = state
+        n = (1 - self.gamma1) * g * g + self.gamma1 * n
+        g_avg = (1 - self.gamma1) * g + self.gamma1 * g_avg
+        delta = self.gamma2 * delta \
+            - lr * g / jnp.sqrt(n - g_avg * g_avg + self.epsilon)
+        w = weight + delta
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        return w, (n, g_avg, delta)
+
 
 @register
 class AdaDelta(Optimizer):
+    fused_supported = True
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho = rho
@@ -346,9 +469,21 @@ class AdaDelta(Optimizer):
             * current_delta * current_delta
         weight[:] = weight - current_delta - wd * weight
 
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        import jax.numpy as jnp
+        g = self._fused_clip(grad)
+        acc_g, acc_delta = state
+        acc_g = self.rho * acc_g + (1.0 - self.rho) * g * g
+        cur = (jnp.sqrt(acc_delta + self.epsilon)
+               / jnp.sqrt(acc_g + self.epsilon)) * g
+        acc_delta = self.rho * acc_delta + (1.0 - self.rho) * cur * cur
+        return weight - cur - wd * weight, (acc_g, acc_delta)
+
 
 @register
 class Ftrl(Optimizer):
+    fused_supported = True
+
     def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1 = lamda1
@@ -371,13 +506,22 @@ class Ftrl(Optimizer):
         sigma += nd.sqrt(n)
         sigma /= lr
         z += g - sigma * weight
-        # update weight
-        import numpy as _np
-        zn = z.asnumpy()
-        nn = n.asnumpy()
-        new_w = (_np.sign(zn) * self.lamda1 - zn) / \
-            ((self.beta + _np.sqrt(nn)) / lr + wd) * (_np.abs(zn) > self.lamda1)
-        weight[:] = new_w.astype(_np.float32)
+        # weight update stays on-device, preserving the weight dtype
+        new_w = (nd.sign(z) * self.lamda1 - z) \
+            / ((self.beta + nd.sqrt(n)) / lr + wd) * (nd.abs(z) > self.lamda1)
+        weight[:] = new_w
+
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        import jax.numpy as jnp
+        g = self._fused_clip(grad)
+        z, n = state
+        new_n = n + g * g
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * weight
+        w = (jnp.sign(z) * self.lamda1 - z) \
+            / ((self.beta + jnp.sqrt(new_n)) / lr + wd) \
+            * (jnp.abs(z) > self.lamda1)
+        return w.astype(weight.dtype), (z, new_n)
 
 
 @register
@@ -385,12 +529,18 @@ class Test(Optimizer):
     """Adds a simple deterministic delta — for kvstore tests
     (ref: optimizer.py Test)."""
 
+    fused_supported = True
+
     def create_state(self, index, weight):
         return _zeros_like(weight)
 
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad
         state[:] = weight
+
+    def fused_update(self, name, weight, grad, state, lr, wd, t, key=None):
+        w = weight + grad
+        return w, w
 
 
 class Updater(object):

@@ -24,13 +24,15 @@ from .executor import _build_graph_runner
 from .initializer import Xavier, InitDesc
 from .ndarray import NDArray
 from .ops import registry as _reg
+from . import optimizer as _opt
+from .optimizer import Optimizer
 from . import random as _random
 
 P = jax.sharding.PartitionSpec
 
-
-def _sgd_mom_init(shape, dtype):
-    return jnp.zeros(shape, dtype)
+# rng stream offset so optimizer noise keys (SGLD) never collide with the
+# graph runner's per-node fold_in(key, node_index) streams
+_OPT_KEY_OFFSET = 1 << 20
 
 
 class TrainStep(object):
@@ -38,13 +40,19 @@ class TrainStep(object):
 
     state = {params, aux, opt, step}; ``step(state, batch)`` returns
     (new_state, outputs) and donates the old state buffers.
+
+    ``optimizer`` may be a registry name (created with learning_rate /
+    momentum / wd) or an Optimizer instance — any optimizer in the zoo with
+    ``fused_supported`` works, including lr_mult/wd_mult from symbol attrs
+    and an lr_scheduler (evaluated host-side per step, fed in as a traced
+    scalar so schedules never retrace).
     """
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), optimizer="sgd",
                  learning_rate=0.01, momentum=0.9, wd=0.0, rescale_grad=None,
                  mesh=None, param_shardings=None, dtype=np.float32,
-                 compute_dtype=None, remat=False):
+                 compute_dtype=None, remat=False, frozen_param_names=None):
         self.symbol = symbol
         self.data_names = list(data_names)
         self.label_names = list(label_names)
@@ -52,10 +60,21 @@ class TrainStep(object):
         self.aux_names = symbol.list_auxiliary_states()
         self.param_names = [n for n in self.arg_names
                             if n not in self.data_names + self.label_names]
+        self.frozen_param_names = set(frozen_param_names or ())
+        if isinstance(optimizer, Optimizer):
+            self._opt = optimizer
+            if rescale_grad is None and optimizer.rescale_grad != 1.0:
+                rescale_grad = optimizer.rescale_grad
+        else:
+            kwargs = {"learning_rate": learning_rate, "wd": wd,
+                      "sym": symbol}
+            if optimizer.lower() in ("sgd", "nag", "ccsgd", "dcasgd"):
+                kwargs["momentum"] = momentum
+            self._opt = _opt.create(optimizer, **kwargs)
+        if not self._opt.fused_supported:
+            raise MXNetError("fused step: optimizer %r has no fused update"
+                             % type(self._opt).__name__)
         self.optimizer = optimizer
-        self.lr = learning_rate
-        self.momentum = momentum
-        self.wd = wd
         self.rescale_grad = rescale_grad
         self.mesh = mesh
         self.param_shardings = dict(param_shardings or {})
@@ -116,12 +135,9 @@ class TrainStep(object):
         return state
 
     def _init_opt_state(self, params):
-        if self.optimizer == "sgd" and self.momentum:
-            return {"mom": {n: jnp.zeros_like(v) for n, v in params.items()}}
-        if self.optimizer == "adam":
-            return {"mean": {n: jnp.zeros_like(v) for n, v in params.items()},
-                    "var": {n: jnp.zeros_like(v) for n, v in params.items()}}
-        return {}
+        return {n: self._opt.create_fused_state(v)
+                for n, v in params.items()
+                if n not in self.frozen_param_names}
 
     # ------------------------------------------------------------------
     def _param_spec(self, name):
@@ -137,7 +153,13 @@ class TrainStep(object):
 
         out = dict(state)
         out["params"] = put_params(state["params"])
-        out["opt"] = {k: put_params(v) for k, v in state["opt"].items()}
+        # optimizer state pytrees shard exactly like their weight
+        out["opt"] = {
+            n: jax.tree_util.tree_map(
+                lambda v, _n=n: jax.device_put(
+                    v, jax.sharding.NamedSharding(mesh, self._param_spec(_n))),
+                st)
+            for n, st in state["opt"].items()}
         repl = jax.sharding.NamedSharding(mesh, P())
         out["aux"] = {n: jax.device_put(v, repl)
                       for n, v in state["aux"].items()}
@@ -154,14 +176,20 @@ class TrainStep(object):
     # ------------------------------------------------------------------
     def _build(self, batch_size):
         run = self._run
+        optzr = self._opt
         param_names = list(self.param_names)
-        lr, momentum, wd = self.lr, self.momentum, self.wd
+        updated = [n for n in param_names if n not in self.frozen_param_names]
         rescale = (self.rescale_grad if self.rescale_grad is not None
                    else 1.0 / batch_size)
-        optimizer = self.optimizer
         compute_dtype = self.compute_dtype
+        needs_key = getattr(optzr, "fused_needs_key", False)
+        # per-parameter lr/wd multipliers resolved by name, matching
+        # Optimizer._get_lr/_get_wd (ref: python/mxnet/optimizer.py)
+        lr_mult = {n: optzr.lr_mult.get(n, 1.0) for n in updated}
+        wd_mult = {n: optzr.wd_mult.get(n, 1.0) for n in updated}
+        wd = optzr.wd
 
-        def step_fn(state, batch, key):
+        def step_fn(state, batch, key, lr_base):
             params, aux, opt = state["params"], state["aux"], state["opt"]
 
             def f(p):
@@ -180,33 +208,20 @@ class TrainStep(object):
             cots = [jnp.ones_like(o) for o in outs]
             cots_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
             (grads,) = vjp_fn((cots, cots_aux))
-            grads = {n: grads[n].astype(state["params"][n].dtype)
-                     for n in param_names}
 
-            new_params = {}
-            new_opt = {k: dict(v) for k, v in opt.items()}
-            for n in param_names:
-                w, g = params[n], grads[n]
-                g = g * rescale
-                if optimizer == "sgd" and momentum:
-                    m = momentum * opt["mom"][n] - lr * (g + wd * w)
-                    new_params[n] = w + m
-                    new_opt["mom"][n] = m
-                elif optimizer == "sgd":
-                    new_params[n] = w - lr * (g + wd * w)
-                elif optimizer == "adam":
-                    t = state["step"].astype(jnp.float32) + 1.0
-                    b1, b2, eps = 0.9, 0.999, 1e-8
-                    g = g + wd * w  # ref: python Adam applies wd to the grad
-                    mean = b1 * opt["mean"][n] + (1 - b1) * g
-                    var = b2 * opt["var"][n] + (1 - b2) * g * g
-                    lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-                    new_params[n] = w - lr_t * mean / (jnp.sqrt(var) + eps)
-                    new_opt["mean"][n] = mean
-                    new_opt["var"][n] = var
-                else:
-                    raise MXNetError("fused step: optimizer %r unsupported"
-                                     % optimizer)
+            t = state["step"].astype(jnp.float32) + 1.0
+            new_params = dict(params)
+            new_opt = {}
+            for i, n in enumerate(updated):
+                w = params[n]
+                g = grads[n].astype(w.dtype) * rescale
+                subkey = (jax.random.fold_in(key, _OPT_KEY_OFFSET + i)
+                          if needs_key else None)
+                new_w, new_s = optzr.fused_update(
+                    n, w, g, opt[n], lr_base * lr_mult[n], wd * wd_mult[n],
+                    t, key=subkey)
+                new_params[n] = new_w
+                new_opt[n] = new_s
             new_aux = dict(aux)
             for k, v in aux_up.items():
                 new_aux[k] = v.astype(aux[k].dtype)
@@ -221,11 +236,17 @@ class TrainStep(object):
         bs = next(iter(batch.values())).shape[0]
         if bs not in self._jit:
             self._jit[bs] = self._build(bs)
-        if self._needs_rng:
+        if self._needs_rng or getattr(self._opt, "fused_needs_key", False):
             key = jax.random.fold_in(jax.random.key(0), state["step"])
         else:
             key = jax.random.key(0)  # static; unused ops ignore it
-        return self._jit[bs](state, batch, key)
+        # scheduler clock advances host-side; lr rides in as a traced scalar
+        self._opt.num_update += 1
+        if self._opt.lr_scheduler is not None:
+            lr = self._opt.lr_scheduler(self._opt.num_update)
+        else:
+            lr = self._opt.lr
+        return self._jit[bs](state, batch, key, jnp.asarray(lr, jnp.float32))
 
 
 def data_parallel_spec(mesh_shape, n_devices=None, devices=None):

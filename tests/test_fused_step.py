@@ -1,0 +1,210 @@
+"""Fused train step: optimizer-zoo equivalence and the Module fast path.
+
+The fused step is the TPU analog of the reference's in-graph optimizer
+update ops + update_on_kvstore fast path (ref:
+src/operator/optimizer_op-inl.h, python/mxnet/model.py:88-117). These tests
+assert the fused jit produces the SAME numbers as the imperative
+Executor + Updater path for every optimizer in the zoo, and that Module.fit
+actually trains through it.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.executor import simple_bind
+from mxnet_tpu.train_step import TrainStep
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+ZOO = [
+    ("sgd", dict(momentum=0.9)),
+    ("sgd", dict(momentum=0.0)),
+    ("sgd", dict(momentum=0.9, clip_gradient=0.02)),
+    ("nag", dict(momentum=0.9)),
+    ("dcasgd", dict(momentum=0.9)),
+    ("adam", {}),
+    ("adagrad", {}),
+    ("rmsprop", {}),
+    ("rmsprop", dict(centered=True)),
+    ("adadelta", {}),
+    ("ftrl", {}),
+    ("test", {}),
+]
+
+
+@pytest.mark.parametrize("name,kwargs", ZOO)
+def test_fused_matches_imperative(name, kwargs):
+    net = _mlp()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(8, 10)).astype(np.float32)
+    y = rng.integers(0, 4, 8).astype(np.float32)
+    batch = {"data": jnp.asarray(X), "softmax_label": jnp.asarray(y)}
+
+    def mk():
+        o = opt.create(name, learning_rate=0.05, rescale_grad=1.0 / 8,
+                       **kwargs)
+        o.wd = 1e-3
+        return o
+
+    step = TrainStep(net, optimizer=mk())
+    state = step.init({"data": (8, 10)}, {"softmax_label": (8,)}, seed=1)
+
+    ex = simple_bind(net, mx.cpu(), grad_req="write", data=(8, 10),
+                     softmax_label=(8,))
+    for n in step.param_names:
+        # copy: the fused step donates its state buffers
+        ex.arg_dict[n]._set_data(jnp.copy(state["params"][n]))
+    upd = opt.get_updater(mk())
+
+    for _ in range(3):
+        state, _outs = step.step(state, batch)
+        ex.forward(is_train=True, data=X, softmax_label=y)
+        ex.backward()
+        for i, n in enumerate(step.param_names):
+            upd(i, ex.grad_dict[n], ex.arg_dict[n])
+
+    for n in step.param_names:
+        np.testing.assert_allclose(
+            np.asarray(state["params"][n]), ex.arg_dict[n].asnumpy(),
+            atol=2e-5, rtol=2e-5, err_msg="%s/%s" % (name, n))
+
+
+def test_fused_lr_scheduler_and_mults():
+    """lr_scheduler + lr_mult/wd_mult must flow into the fused update."""
+    net = _mlp()
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(8, 10)).astype(np.float32)
+    y = rng.integers(0, 4, 8).astype(np.float32)
+    batch = {"data": jnp.asarray(X), "softmax_label": jnp.asarray(y)}
+
+    def mk():
+        o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                       rescale_grad=1.0 / 8,
+                       lr_scheduler=mx.lr_scheduler.FactorScheduler(
+                           step=2, factor=0.5),
+                       param_idx2name={0: "fc1_weight", 1: "fc1_bias",
+                                       2: "fc2_weight", 3: "fc2_bias"})
+        o.wd = 1e-2
+        o.set_lr_mult({"fc1_weight": 0.3})
+        o.set_wd_mult({"fc2_weight": 2.0})
+        return o
+
+    step = TrainStep(net, optimizer=mk())
+    state = step.init({"data": (8, 10)}, {"softmax_label": (8,)}, seed=2)
+
+    ex = simple_bind(net, mx.cpu(), grad_req="write", data=(8, 10),
+                     softmax_label=(8,))
+    for n in step.param_names:
+        ex.arg_dict[n]._set_data(jnp.copy(state["params"][n]))
+    imp = mk()
+    upd = opt.get_updater(imp)
+    idx_of = {n: i for i, n in enumerate(
+        ["fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"])}
+
+    for _ in range(5):  # crosses the scheduler step boundary
+        state, _ = step.step(state, batch)
+        ex.forward(is_train=True, data=X, softmax_label=y)
+        ex.backward()
+        for n in step.param_names:
+            upd(idx_of[n], ex.grad_dict[n], ex.arg_dict[n])
+
+    for n in step.param_names:
+        np.testing.assert_allclose(
+            np.asarray(state["params"][n]), ex.arg_dict[n].asnumpy(),
+            atol=2e-5, rtol=2e-5, err_msg=n)
+
+
+def _fit_data(batch_size=16, n=64, shuffle=True):
+    rng = np.random.default_rng(3)
+    templates = rng.normal(size=(4, 10)).astype(np.float32)
+    X = templates[rng.integers(0, 4, n)] \
+        + 0.05 * rng.normal(size=(n, 10)).astype(np.float32)
+    y = np.argmin(((X[:, None, :] - templates[None]) ** 2).sum(-1),
+                  axis=1).astype(np.float32)
+    return (mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=shuffle),
+            X, y)
+
+
+def test_module_fit_uses_fused_path():
+    net = _mlp()
+    it, X, y = _fit_data()
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=4, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.2})
+    assert mod._fused is not None, "fit() did not engage the fused path"
+    assert int(np.asarray(mod._fused_state["step"])) > 0
+    val = mx.io.NDArrayIter(X, y, batch_size=16)
+    acc = dict(mod.score(val, mx.metric.Accuracy()))
+    assert acc["accuracy"] > 0.9, acc
+
+
+def test_module_fit_fused_equals_executor_path():
+    """Same seed, same data: fused fit must equal the executor-path fit."""
+    def train(disable_fused):
+        net = _mlp()
+        it, X, y = _fit_data(shuffle=False)  # identical batch order
+        mod = mx.mod.Module(net)
+        if disable_fused:
+            mod._fused_ok = False
+        mx.random.seed(7)
+        mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        return mod.get_params()[0]
+
+    a = train(False)
+    b = train(True)
+    for n in a:
+        np.testing.assert_allclose(a[n].asnumpy(), b[n].asnumpy(),
+                                   atol=1e-5, rtol=1e-5, err_msg=n)
+
+
+def test_module_fused_checkpoint_roundtrip(tmp_path):
+    net = _mlp()
+    it, X, y = _fit_data()
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    prefix = str(tmp_path / "fused_ckpt")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    mod2.init_optimizer(optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9})
+    # predictions identical after round trip
+    val = mx.io.NDArrayIter(X, y, batch_size=16)
+    p1 = mod.predict(val).asnumpy()
+    val = mx.io.NDArrayIter(X, y, batch_size=16)
+    p2 = mod2.predict(val).asnumpy()
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+    # momentum state survived into the new module's fused seed
+    it.reset()
+    batch = next(iter(it))
+    assert mod2._try_fused_fit_step(batch)
+    mom = mod2._fused_state["opt"]["fc1_weight"]
+    assert float(jnp.abs(mom).max()) > 0.0
+
+
+def test_module_fixed_params_stay_fixed():
+    net = _mlp()
+    it, X, y = _fit_data()
+    mod = mx.mod.Module(net, fixed_param_names=["fc1_weight"])
+    mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.2})
+    assert mod._fused is not None
+    w0 = np.asarray(mod._fused_state["params"]["fc1_weight"])
+    it.reset()
+    for batch in it:
+        assert mod._try_fused_fit_step(batch)
+    np.testing.assert_array_equal(
+        w0, np.asarray(mod._fused_state["params"]["fc1_weight"]))
