@@ -115,7 +115,8 @@ def main():
     flops_per_img = None
     try:
         key = jax.random.key(0)
-        lowered = step._jit[batch].lower(state, data, key)
+        lr_base = jnp.asarray(0.1, jnp.float32)
+        lowered = step._jit[batch].lower(state, data, key, lr_base)
         try:
             ca = lowered.cost_analysis()
         except Exception:
@@ -125,8 +126,9 @@ def main():
         if isinstance(ca, list):
             ca = ca[0]
         flops_per_img = float(ca["flops"]) / batch
-    except Exception:
-        pass
+    except Exception as exc:  # MFU is a headline metric: never drop silently
+        print("WARNING: cost analysis failed, no MFU emitted: %r" % exc,
+              file=sys.stderr)
 
     peak, kind = _peak_flops(jax.devices()[0])
     out = {

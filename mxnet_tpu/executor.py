@@ -48,7 +48,12 @@ def _build_graph_runner(symbol):
             if node.op.needs_rng and key is not None:
                 rng = jax.random.fold_in(key, k)
             op_ctx = OpContext(is_train=is_train, rng=rng)
-            outs, aux_up = node.op.apply(op_ctx, node.attrs, ins, aux_in)
+            # named_scope threads op names into XLA metadata so profiler
+            # traces show MXNet op names, not anonymous fusions (ref:
+            # PROFILER_MESSAGE threading names through every engine push,
+            # include/mxnet/base.h:79-83)
+            with jax.named_scope("%s:%s" % (node.op.name, node.name)):
+                outs, aux_up = node.op.apply(op_ctx, node.attrs, ins, aux_in)
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o
             if aux_up is not None:

@@ -133,12 +133,15 @@ class TopKAccuracy(EvalMetric):
             pred_label = _np(pred_label)
             label = _np(label).astype("int32")
             assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(pred_label.astype("float32"), axis=1)
             num_samples = pred_label.shape[0]
             num_dims = len(pred_label.shape)
+            # dims checked BEFORE argsort: the reference argsorts(axis=1)
+            # first, making its 1-D branch unreachable (1-D preds raised) —
+            # here 1-D preds are class ids and score directly
             if num_dims == 1:
                 self.sum_metric += (pred_label.flatten() == label.flatten()).sum()
             elif num_dims == 2:
+                pred_label = numpy.argsort(pred_label.astype("float32"), axis=1)
                 num_classes = pred_label.shape[1]
                 top_k = min(num_classes, self.top_k)
                 for j in range(top_k):

@@ -197,7 +197,10 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group._replicate_params()
+        # executor arrays are now authoritative: the fused copy must be
+        # re-seeded from them, never written back over them
         self._fused_params_stale = True
+        self._fused_dirty = False
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
@@ -212,6 +215,7 @@ class Module(BaseModule):
         self._params_dirty = True
         self.params_initialized = True
         self._fused_params_stale = True
+        self._fused_dirty = False
 
     # -- bind -----------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -265,6 +269,12 @@ class Module(BaseModule):
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
+        # flush + drop the fused state: its jit cache and param tree are
+        # keyed to the old shapes and would silently train on stale data
+        self._sync_fused_to_executor()
+        self._fused = None
+        self._fused_state = None
+        self._fused_outputs = None
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
         shapes = {}
@@ -285,6 +295,9 @@ class Module(BaseModule):
             self.logger.warning("optimizer already initialized, "
                                 "ignoring init_optimizer")
             return
+        # a dirty fused state holds the latest trained weights; flush it
+        # before the reset below discards it (e.g. re-init to change lr)
+        self._sync_fused_to_executor()
 
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)

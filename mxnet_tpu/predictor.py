@@ -15,6 +15,43 @@ from . import ndarray as nd
 from . import symbol as sym
 from .context import current_context
 
+# loss heads → inference-time equivalent op on the head's data input
+# (ref: c_predict_api binds the net for prediction; the loss ops' forward is
+# label-independent, so stripping the head drops the label argument entirely)
+_LOSS_HEADS = {
+    "SoftmaxOutput": "SoftmaxActivation",
+    "LogisticRegressionOutput": "sigmoid",
+    "LinearRegressionOutput": "identity",
+    "MAERegressionOutput": "identity",
+    "SVMOutput": "identity",
+    "MakeLoss": "identity",
+    "IdentityAttachKLSparseReg": "identity",
+}
+
+
+def _strip_loss_heads(symbol):
+    """Rewrite loss-head outputs to their inference transform so binding
+    needs no label arrays (labels vanish from list_arguments)."""
+    from .symbol import Symbol, _Node
+    from .ops import registry as _reg
+    new_outputs = []
+    changed = False
+    for node, idx in symbol._outputs:
+        if (not node.is_variable) and node.op.name in _LOSS_HEADS:
+            repl = _LOSS_HEADS[node.op.name]
+            attrs = {}
+            if repl == "SoftmaxActivation":
+                from .base import attr_bool
+                mo = attr_bool(node.attrs.get("multi_output", False), False)
+                attrs["mode"] = "channel" if mo else "instance"
+            new = _Node(_reg.get(repl), node.name, attrs,
+                        [node.inputs[0]], node._user_attr)
+            new_outputs.append((new, 0))
+            changed = True
+        else:
+            new_outputs.append((node, idx))
+    return Symbol(new_outputs) if changed else symbol
+
 
 class Predictor(object):
     def __init__(self, symbol_json_or_file, param_file_or_dict, input_shapes,
@@ -27,8 +64,7 @@ class Predictor(object):
                 self._symbol = sym.load(symbol_json_or_file)
         else:
             self._symbol = symbol_json_or_file
-        # strip loss heads for inference when present (ref: c_predict picks
-        # the network output)
+        self._symbol = _strip_loss_heads(self._symbol)
         if isinstance(param_file_or_dict, str):
             loaded = nd.load(param_file_or_dict)
         else:

@@ -14,27 +14,48 @@ import threading
 import jax
 import numpy as _np
 
-_state = threading.local()
+# process-global (NOT thread-local: seed() must reach PrefetchingIter
+# producer threads too, or every worker thread re-seeds itself to the
+# default and draws identical streams); a lock serializes split()
+_lock = threading.Lock()
+_key = None
 
 _DEFAULT_SEED = 0
 
 
 def _get():
-    if not hasattr(_state, "key"):
-        _state.key = jax.random.key(_DEFAULT_SEED)
-    return _state.key
+    global _key
+    if _key is None:
+        _key = jax.random.key(_DEFAULT_SEED)
+    return _key
 
 
 def seed(seed_state):
     """Seed the global random number generator (parity: mx.random.seed)."""
-    _state.key = jax.random.key(int(seed_state))
+    global _key
+    with _lock:
+        _key = jax.random.key(int(seed_state))
 
 
 def split():
     """Return a fresh PRNG subkey, advancing the global state."""
-    key, sub = jax.random.split(_get())
-    _state.key = key
+    global _key
+    with _lock:
+        key, sub = jax.random.split(_get())
+        _key = key
     return sub
+
+
+def get_state():
+    """Snapshot the global PRNG key (for scoped seeding)."""
+    return _get()
+
+
+def set_state(key):
+    """Restore a key captured by get_state()."""
+    global _key
+    with _lock:
+        _key = key
 
 
 def np_rng():

@@ -63,7 +63,11 @@ class TrainStep(object):
         self.frozen_param_names = set(frozen_param_names or ())
         if isinstance(optimizer, Optimizer):
             self._opt = optimizer
-            if rescale_grad is None and optimizer.rescale_grad != 1.0:
+            # an instance's rescale_grad is authoritative (even 1.0): the
+            # imperative updater applies it verbatim, so the fused path must
+            # too; the 1/batch_size default exists only for the
+            # string-optimizer convenience constructor
+            if rescale_grad is None:
                 rescale_grad = optimizer.rescale_grad
         else:
             kwargs = {"learning_rate": learning_rate, "wd": wd,
@@ -94,6 +98,7 @@ class TrainStep(object):
         if remat:
             self._run = self._wrap_remat(self._run)
         self._jit = {}  # keyed by batch size (rescale_grad depends on it)
+        self._base_key = None  # drawn lazily from the global seeded stream
 
     # ------------------------------------------------------------------
     def _wrap_remat(self, run):
@@ -115,18 +120,25 @@ class TrainStep(object):
         shape_of = dict(zip(self.arg_names, arg_shapes))
         aux_shape_of = dict(zip(self.aux_names, aux_shapes))
         initializer = initializer or Xavier()
-        _random.seed(seed)
         attrs = self.symbol.attr_dict()
-        params = {}
-        for n in self.param_names:
-            arr = NDArray(jnp.zeros(shape_of[n], self.dtype))
-            initializer(InitDesc(n, attrs.get(n, {})), arr)
-            params[n] = arr.data
-        aux = {}
-        for n in self.aux_names:
-            arr = NDArray(jnp.zeros(aux_shape_of[n], self.dtype))
-            initializer(InitDesc(n, attrs.get(n, {})), arr)
-            aux[n] = arr.data
+        # scoped seeding: deterministic init draws WITHOUT clobbering the
+        # process-global stream (mx.random.seed set by the user must keep
+        # governing dropout/SGLD keys drawn later in step())
+        saved = _random.get_state()
+        _random.seed(seed)
+        try:
+            params = {}
+            for n in self.param_names:
+                arr = NDArray(jnp.zeros(shape_of[n], self.dtype))
+                initializer(InitDesc(n, attrs.get(n, {})), arr)
+                params[n] = arr.data
+            aux = {}
+            for n in self.aux_names:
+                arr = NDArray(jnp.zeros(aux_shape_of[n], self.dtype))
+                initializer(InitDesc(n, attrs.get(n, {})), arr)
+                aux[n] = arr.data
+        finally:
+            _random.set_state(saved)
         opt = self._init_opt_state(params)
         state = {"params": params, "aux": aux, "opt": opt,
                  "step": jnp.zeros((), jnp.int32)}
@@ -237,7 +249,12 @@ class TrainStep(object):
         if bs not in self._jit:
             self._jit[bs] = self._build(bs)
         if self._needs_rng or getattr(self._opt, "fused_needs_key", False):
-            key = jax.random.fold_in(jax.random.key(0), state["step"])
+            # base key rides the global seeded stream (mx.random.seed), so
+            # dropout/SGLD respond to seeding and two TrainSteps never share
+            # noise; per-step keys fold in the step counter
+            if self._base_key is None:
+                self._base_key = _random.split()
+            key = jax.random.fold_in(self._base_key, state["step"])
         else:
             key = jax.random.key(0)  # static; unused ops ignore it
         # scheduler clock advances host-side; lr rides in as a traced scalar

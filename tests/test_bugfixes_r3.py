@@ -1,0 +1,214 @@
+"""Round-3 bug-sweep regressions.
+
+Each test pins a previously reported defect: fused/executor param-authority
+races in Module, silent rescale_grad divergence, seedable fused-step RNG,
+cross-thread random seeding, TopKAccuracy 1-D scoring, Predictor loss-head
+stripping, and Module.reshape fused-state invalidation.
+"""
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.train_step import TrainStep
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit_data(batch_size=16, n=64):
+    rng = np.random.default_rng(3)
+    templates = rng.normal(size=(4, 10)).astype(np.float32)
+    X = templates[rng.integers(0, 4, n)] \
+        + 0.05 * rng.normal(size=(n, 10)).astype(np.float32)
+    y = np.argmin(((X[:, None, :] - templates[None]) ** 2).sum(-1),
+                  axis=1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch_size), X, y
+
+
+def test_set_params_after_fused_fit_is_authoritative():
+    """set_params after a fused fit must not be overwritten by a stale
+    fused-state writeback on the next forward."""
+    net = _mlp()
+    it, X, y = _fit_data()
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None
+    zeros_args = {n: mx.nd.zeros(v.shape)
+                  for n, v in mod.get_params()[0].items()}
+    mod.set_params(zeros_args, {}, force_init=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=16)
+    batch = next(iter(val))
+    mod.forward(batch, is_train=False)
+    args, _ = mod.get_params()
+    for n, v in args.items():
+        assert float(np.abs(v.asnumpy()).max()) == 0.0, n
+
+
+def test_init_optimizer_force_init_keeps_trained_params():
+    """Re-initializing the optimizer mid-run (e.g. to change lr) must flush
+    the fused state first, not discard the trained weights."""
+    net = _mlp()
+    it, X, y = _fit_data()
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.2})
+    trained = {n: v.asnumpy() for n, v in mod.get_params()[0].items()}
+    # mark fused dirty again with one more step, then force re-init
+    it.reset()
+    assert mod._try_fused_fit_step(next(iter(it)))
+    stepped = {n: np.asarray(mod._fused_state["params"][n]) for n in trained}
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.01},
+                       force_init=True)
+    after = {n: v.asnumpy() for n, v in mod.get_params()[0].items()}
+    for n in trained:
+        np.testing.assert_allclose(after[n], stepped[n], atol=1e-6,
+                                   err_msg=n)
+
+
+def test_trainstep_explicit_rescale_grad_one_honored():
+    """An Optimizer instance with rescale_grad=1.0 must be applied verbatim
+    by the fused path (not silently replaced with 1/batch_size)."""
+    net = _mlp()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(8, 10)).astype(np.float32)
+    y = rng.integers(0, 4, 8).astype(np.float32)
+    batch = {"data": jnp.asarray(X), "softmax_label": jnp.asarray(y)}
+
+    def mk():
+        return opt.create("sgd", learning_rate=0.05, momentum=0.0,
+                          rescale_grad=1.0)
+
+    step = TrainStep(net, optimizer=mk())
+    state = step.init({"data": (8, 10)}, {"softmax_label": (8,)}, seed=1)
+
+    from mxnet_tpu.executor import simple_bind
+    ex = simple_bind(net, mx.cpu(), grad_req="write", data=(8, 10),
+                     softmax_label=(8,))
+    for n in step.param_names:
+        ex.arg_dict[n]._set_data(jnp.copy(state["params"][n]))
+    upd = opt.get_updater(mk())
+    for _ in range(2):
+        state, _ = step.step(state, batch)
+        ex.forward(is_train=True, data=X, softmax_label=y)
+        ex.backward()
+        for i, n in enumerate(step.param_names):
+            upd(i, ex.grad_dict[n], ex.arg_dict[n])
+    for n in step.param_names:
+        np.testing.assert_allclose(np.asarray(state["params"][n]),
+                                   ex.arg_dict[n].asnumpy(),
+                                   atol=2e-5, rtol=2e-5, err_msg=n)
+
+
+def test_trainstep_rng_respects_global_seed():
+    """mx.random.seed must reach dropout inside the fused step."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(8, 10)).astype(np.float32)
+    y = rng.integers(0, 4, 8).astype(np.float32)
+    batch = {"data": jnp.asarray(X), "softmax_label": jnp.asarray(y)}
+
+    def one_step(seed):
+        mx.random.seed(seed)
+        step = TrainStep(net, optimizer="sgd", learning_rate=0.1)
+        state = step.init({"data": (8, 10)}, {"softmax_label": (8,)}, seed=1)
+        state, outs = step.step(state, batch)
+        return np.asarray(outs[0])
+
+    a = one_step(11)
+    b = one_step(11)
+    c = one_step(12)
+    np.testing.assert_array_equal(a, b)
+    assert np.abs(a - c).max() > 0, "seed had no effect on fused dropout"
+
+
+def test_random_seed_reaches_other_threads():
+    """Seeding is process-global: a producer thread (PrefetchingIter) must
+    see the seeded stream, and two threads must not draw identical keys."""
+    mx.random.seed(42)
+    main_draw = mx.random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(42)
+    results = {}
+
+    def worker(tag):
+        results[tag] = mx.random.uniform(shape=(4,)).asnumpy()
+
+    t = threading.Thread(target=worker, args=("t1",))
+    t.start()
+    t.join()
+    np.testing.assert_array_equal(main_draw, results["t1"])
+    # successive draws across threads advance one shared stream
+    t2 = threading.Thread(target=worker, args=("t2",))
+    t2.start()
+    t2.join()
+    assert np.abs(results["t1"] - results["t2"]).max() > 0
+
+
+def test_topk_accuracy_1d_preds():
+    """1-D predictions are class ids; previously unreachable branch raised."""
+    m = mx.metric.TopKAccuracy(top_k=2)
+    labels = [mx.nd.array(np.array([0, 1, 2, 3], np.float32))]
+    preds_1d = [mx.nd.array(np.array([0, 1, 0, 3], np.float32))]
+    m.update(labels, preds_1d)
+    assert m.get()[1] == 0.75
+    # 2-D path still works
+    m2 = mx.metric.TopKAccuracy(top_k=2)
+    p = np.zeros((4, 4), np.float32)
+    p[np.arange(4), [0, 1, 2, 3]] = 1.0
+    m2.update(labels, [mx.nd.array(p)])
+    assert m2.get()[1] == 1.0
+
+
+def test_predictor_strips_softmax_head(tmp_path):
+    """Predictor must bind a SoftmaxOutput-headed symbol with only data."""
+    net = _mlp()
+    it, X, y = _fit_data()
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "strip")
+    mod.save_checkpoint(prefix, 1)
+    pred = mx.Predictor(prefix + "-symbol.json", prefix + "-0001.params",
+                        {"data": (16, 10)})
+    # label must NOT be an input anymore
+    assert "softmax_label" not in pred._symbol.list_arguments()
+    out = pred.forward(data=X[:16]).get_output(0).asnumpy()
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(16), atol=1e-5)
+    # numerics match Module's inference
+    val = mx.io.NDArrayIter(X[:16], y[:16], batch_size=16)
+    ref = mod.predict(val).asnumpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_module_reshape_invalidates_fused_state():
+    net = _mlp()
+    it, X, y = _fit_data()
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None
+    trained = {n: v.asnumpy() for n, v in mod.get_params()[0].items()}
+    mod.reshape(data_shapes=[("data", (8, 10))],
+                label_shapes=[("softmax_label", (8,))])
+    assert mod._fused is None and mod._fused_state is None
+    # trained params survived the reshape
+    after = {n: v.asnumpy() for n, v in mod.get_params()[0].items()}
+    for n in trained:
+        np.testing.assert_allclose(after[n], trained[n], atol=1e-6,
+                                   err_msg=n)
+    batch = mx.io.DataBatch(data=[mx.nd.array(X[:8])],
+                            label=[mx.nd.array(y[:8])])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape[0] == 8
