@@ -29,17 +29,35 @@ from .symbol import Symbol, _topo
 from . import random as _random
 
 
-def _build_graph_runner(symbol):
+def _build_graph_runner(symbol, placement=None):
     """Lower the symbol DAG to a pure function
-    run(arg_vals: dict, aux_vals: dict, key, is_train) -> (outputs, aux_updates)."""
+    run(arg_vals: dict, aux_vals: dict, key, is_train) -> (outputs, aux_updates).
+
+    ``placement`` (parallel.placement.GroupPlacement) lowers ctx_group
+    annotations to per-node sharding constraints — the SPMD analog of the
+    reference's PlaceDevice pass + _CrossDeviceCopy insertion
+    (ref: src/executor/graph_executor.cc:244-334)."""
     nodes = _topo(symbol._out_nodes())
+    node_groups = {}
+    if placement is not None:
+        from .parallel.placement import node_group, param_groups
+        node_groups = {id(n): node_group(n) for n in nodes}
+        var_groups = param_groups(nodes)
 
     def run(arg_vals, aux_vals, key, is_train):
         env = {}
         aux_updates = {}
         for k, node in enumerate(nodes):
             if node.is_variable:
-                env[(id(node), 0)] = arg_vals[node.name]
+                v = arg_vals[node.name]
+                if placement is not None:
+                    g = var_groups.get(node.name)
+                    if g is not None:
+                        # is_param: confirm the allocation-time layout
+                        # (first-dim rule) rather than forcing an
+                        # activation-style reshard of every weight per step
+                        v = placement.constrain(g, v, is_param=True)
+                env[(id(node), 0)] = v
                 continue
             ins = [env[(id(n), i)] for n, i in node.inputs]
             aux_names = node.op.list_aux(node.attrs)
@@ -54,6 +72,9 @@ def _build_graph_runner(symbol):
             # include/mxnet/base.h:79-83)
             with jax.named_scope("%s:%s" % (node.op.name, node.name)):
                 outs, aux_up = node.op.apply(op_ctx, node.attrs, ins, aux_in)
+            g = node_groups.get(id(node))
+            if g is not None:
+                outs = [placement.constrain(g, o) for o in outs]
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o
             if aux_up is not None:
@@ -99,7 +120,16 @@ class Executor(object):
                  aux_states=None, group2ctx=None, shared_exec=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
-        self._group2ctx = group2ctx or {}
+        # ctx_group model parallelism: lower group annotations to mesh
+        # sharding constraints (see parallel/placement.py); simple_bind
+        # passes an already-resolved GroupPlacement
+        from .parallel import placement as _placement
+        if isinstance(group2ctx, _placement.GroupPlacement):
+            self._placement = group2ctx
+            self._group2ctx = dict(group2ctx.raw)
+        else:
+            self._group2ctx = group2ctx or {}
+            self._placement = _placement.resolve(self._group2ctx)
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -128,7 +158,7 @@ class Executor(object):
                 raise MXNetError("grad_req %r for %s but no grad array bound"
                                  % (self._grad_req[n], n))
 
-        self._run, self._nodes = _build_graph_runner(symbol)
+        self._run, self._nodes = _build_graph_runner(symbol, self._placement)
         self._diff_args = [n for n in self.arg_names
                            if self._grad_req.get(n, "null") != "null"]
         # group diff args by grad-buffer identity: a buffer shared across
@@ -376,7 +406,9 @@ class Executor(object):
             aux[n] = (cur if sh is None or tuple(cur.shape) == tuple(sh)
                       else NDArray(jnp.zeros(sh, cur.data.dtype)))
         return Executor(self._symbol, self._ctx, args, grads or None,
-                        self._grad_req, aux, group2ctx=self._group2ctx)
+                        self._grad_req, aux,
+                        group2ctx=(self._placement if self._placement
+                                   is not None else self._group2ctx))
 
     @property
     def symbol(self):
@@ -403,6 +435,24 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
     aux_names = symbol.list_auxiliary_states()
     type_dict = type_dict or {}
 
+    # group2ctx: allocate each group's parameters SHARDED over the mesh so
+    # weight memory distributes across devices (the capacity win that
+    # motivated the reference's layer-per-GPU placement)
+    from .parallel import placement as _placement
+    gp = _placement.resolve(group2ctx)
+    pgroups = (_placement.param_groups(_topo(symbol._out_nodes()))
+               if gp is not None else {})
+
+    def _alloc(n, sh, dt):
+        arr = jnp.zeros(sh, dt)
+        g = pgroups.get(n)
+        if g is not None:
+            spec = gp.param_spec(g, sh)
+            if spec is not None:
+                arr = jax.device_put(
+                    arr, jax.sharding.NamedSharding(gp.mesh, spec))
+        return NDArray(arr)
+
     def _shared(pool, n, sh, dt):
         # reuse the shared executor's arrays when shape AND dtype match
         # (ref: shared_exec memory pool, graph_executor.cc:352-355,:505-512 —
@@ -418,14 +468,14 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
     for n, sh in zip(arg_names, arg_shapes):
         dt = np.dtype(type_dict.get(n, np.float32))
         shared = _shared(shared_exec.arg_dict if shared_exec else {}, n, sh, dt)
-        args[n] = shared if shared is not None else NDArray(jnp.zeros(sh, dt))
+        args[n] = shared if shared is not None else _alloc(n, sh, dt)
         req = grad_req if isinstance(grad_req, str) else (
             grad_req[arg_names.index(n)] if isinstance(grad_req, (list, tuple))
             else grad_req.get(n, "null"))
         if req != "null":
             sg = _shared(shared_exec.grad_dict if shared_exec else {}, n, sh,
                          dt)
-            grads[n] = sg if sg is not None else NDArray(jnp.zeros(sh, dt))
+            grads[n] = sg if sg is not None else _alloc(n, sh, dt)
     aux = {}
     for n, sh in zip(aux_names, aux_shapes):
         sa = _shared(shared_exec.aux_dict if shared_exec else {}, n, sh,
@@ -433,4 +483,5 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
         aux[n] = sa if sa is not None else NDArray(
             jnp.zeros(sh, np.dtype(np.float32)))
     return Executor(symbol, ctx, args, grads or None, grad_req, aux,
-                    group2ctx=group2ctx, shared_exec=shared_exec)
+                    group2ctx=gp if gp is not None else group2ctx,
+                    shared_exec=shared_exec)

@@ -1,0 +1,217 @@
+"""group2ctx model parallelism lowered to mesh shardings.
+
+The reference implements model parallelism by *placement*: the PlaceDevice
+pass colors each node with the device of its ``ctx_group`` and inserts
+``_CrossDeviceCopy`` nodes at color boundaries
+(ref: src/executor/graph_executor.cc:244-334, example/model-parallel-lstm/
+lstm.py:48-112). That is an MPMD design for GPUs + NCCL.
+
+XLA on TPU is SPMD: one program runs on every device and tensors are
+*sharded*, not placed. The idiomatic lowering of ``group2ctx`` is therefore:
+
+- each ctx_group maps to a **sharding spec** over the ambient device mesh;
+- the graph runner applies ``jax.lax.with_sharding_constraint`` to every
+  node output in the group — the exact analog of ``_CrossDeviceCopy``: XLA
+  inserts the resharding collectives at group boundaries, riding ICI;
+- parameters consumed by a group are allocated sharded with a matching spec,
+  so each group's weight memory lives distributed across the mesh — the
+  memory-capacity win that motivated layer-per-GPU placement.
+
+Sharding constraints never change values (collectives are inserted to
+preserve semantics), so a group2ctx-annotated model is numerically identical
+to its single-device run — a property the reference could only approximate.
+
+``group2ctx`` values accepted:
+
+- mesh axis name (str), e.g. ``{'decode': 'model'}`` — outputs and params
+  of the group are sharded over that axis on their last (outputs) / first
+  (params) dimension divisible by the axis size;
+- ``jax.sharding.PartitionSpec`` — applied verbatim to every output whose
+  rank/shape admits it (non-divisible or rank-short outputs stay
+  unconstrained);
+- ``jax.sharding.NamedSharding`` — spec + explicit mesh;
+- ``Context`` (legacy API, e.g. ``mx.gpu(1)``) — accepted for source
+  compatibility; physical placement is XLA's job under SPMD, so this is
+  recorded but lowers to no constraint.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..context import Context
+
+P = jax.sharding.PartitionSpec
+
+
+def _axis_size(mesh, names):
+    """Total number of shards for one PartitionSpec entry (str or tuple)."""
+    if isinstance(names, str):
+        names = (names,)
+    return int(np.prod([dict(zip(mesh.axis_names, mesh.devices.shape))[n]
+                        for n in names]))
+
+
+def _fit_spec(spec, shape, mesh):
+    """Clip a PartitionSpec to a concrete shape: entries that don't divide
+    their dimension (or exceed the rank) become None. Returns None if no
+    dimension ends up sharded."""
+    out = []
+    any_sharded = False
+    for d in range(len(shape)):
+        e = spec[d] if d < len(spec) else None
+        if e is None:
+            out.append(None)
+            continue
+        if shape[d] % _axis_size(mesh, e) == 0:
+            out.append(e)
+            any_sharded = True
+        else:
+            out.append(None)
+    return P(*out) if any_sharded else None
+
+
+def _auto_spec(axis, shape, mesh, prefer_first=False):
+    """Pick one dimension to shard over ``axis``: the last (or first, for
+    parameters) dimension divisible by the axis size."""
+    n = _axis_size(mesh, axis)
+    dims = range(len(shape)) if prefer_first else reversed(range(len(shape)))
+    for d in dims:
+        if shape[d] > 1 and shape[d] % n == 0:
+            return P(*([None] * d + [axis]))
+    return None
+
+
+def _spec_axes(rule):
+    """All mesh axis names a rule refers to."""
+    if isinstance(rule, str):
+        return [rule]
+    out = []
+    for e in rule:
+        if e is None:
+            continue
+        out.extend([e] if isinstance(e, str) else list(e))
+    return out
+
+
+class GroupPlacement(object):
+    """Resolved group2ctx: callable constraint per group + param specs."""
+
+    def __init__(self, group2ctx, mesh):
+        from ..base import MXNetError
+        self.mesh = mesh
+        self.raw = dict(group2ctx or {})   # as the user wrote it
+        self.groups = {}        # name -> (rule, mesh) ; rule None = legacy
+        for g, v in (group2ctx or {}).items():
+            if isinstance(v, Context):
+                # legacy device placement: under SPMD, XLA owns physical
+                # placement; record the group so attrs round-trip
+                self.groups[g] = (None, None)
+                continue
+            if isinstance(v, jax.sharding.NamedSharding):
+                rule, gmesh = v.spec, v.mesh
+            elif isinstance(v, (P, str)):
+                rule, gmesh = v, mesh
+            else:
+                raise TypeError(
+                    "group2ctx[%r]: expected Context, mesh axis name, "
+                    "PartitionSpec or NamedSharding, got %r" % (g, v))
+            if gmesh is None:
+                raise MXNetError(
+                    "group2ctx[%r] = %r needs a device mesh: pass mesh= or "
+                    "bind inside `with MeshScope(mesh):`" % (g, v))
+            bad = [a for a in _spec_axes(rule) if a not in gmesh.axis_names]
+            if bad:
+                raise MXNetError(
+                    "group2ctx[%r]: axis %r not in mesh axes %r"
+                    % (g, bad[0], tuple(gmesh.axis_names)))
+            if self.mesh is None:
+                self.mesh = gmesh
+            elif gmesh is not self.mesh and (
+                    tuple(gmesh.axis_names) != tuple(self.mesh.axis_names)
+                    or gmesh.devices.shape != self.mesh.devices.shape):
+                # one jit = one mesh: XLA cannot mix meshes in a computation
+                raise MXNetError(
+                    "group2ctx[%r]: NamedSharding mesh %r conflicts with the "
+                    "binding mesh %r — all groups must share one mesh"
+                    % (g, tuple(gmesh.axis_names),
+                       tuple(self.mesh.axis_names)))
+            self.groups[g] = (rule, gmesh)
+
+    def _resolve_spec(self, group, shape, prefer_first=False):
+        if group not in self.groups:
+            return None, None
+        rule, mesh = self.groups[group]
+        if rule is None or mesh is None or len(shape) == 0:
+            return None, None
+        if isinstance(rule, str):
+            return _auto_spec(rule, shape, mesh, prefer_first), mesh
+        if prefer_first:
+            # params: reuse the rule's first named axis, first divisible dim
+            for e in rule:
+                if e is not None:
+                    ax = e if isinstance(e, str) else e[0]
+                    return _auto_spec(ax, shape, mesh, True), mesh
+            return None, None
+        return _fit_spec(rule, shape, mesh), mesh
+
+    def constrain(self, group, value, is_param=False):
+        """with_sharding_constraint for one node value (trace-time).
+        Parameters use the same first-dim rule as their allocation so the
+        constraint confirms the resident layout instead of forcing a
+        reshard every step."""
+        spec, mesh = self._resolve_spec(group, getattr(value, "shape", ()),
+                                        prefer_first=is_param)
+        if spec is None:
+            return value
+        return jax.lax.with_sharding_constraint(
+            value, jax.sharding.NamedSharding(mesh, spec))
+
+    def param_spec(self, group, shape):
+        """Sharding spec for a parameter consumed by ``group`` (first
+        divisible dim — e.g. the (4H, D) LSTM i2h weight splits its gate
+        dim across the axis, Megatron-style)."""
+        spec, _ = self._resolve_spec(group, shape, prefer_first=True)
+        return spec
+
+
+def node_group(node):
+    """The ctx_group annotation of a graph node (AttrScope(ctx_group=...))."""
+    return node._user_attr.get("ctx_group")
+
+
+def param_groups(nodes):
+    """Map variable name -> ctx_group, from the variable's own annotation or
+    (fallback) the single group of its consumers — mirrors how PlaceDevice
+    propagates colors to inputs (ref: graph_executor.cc:244-334)."""
+    out = {}
+    consumers = {}
+    for node in nodes:
+        if node.is_variable:
+            g = node_group(node)
+            if g is not None:
+                out[node.name] = g
+            continue
+        g = node_group(node)
+        if g is None:
+            continue
+        for inp, _ in node.inputs:
+            if inp.is_variable:
+                consumers.setdefault(inp.name, set()).add(g)
+    for name, gs in consumers.items():
+        if name not in out and len(gs) == 1:
+            out[name] = next(iter(gs))
+    return out
+
+
+def resolve(group2ctx, mesh=None):
+    """Build a GroupPlacement (or None if there is nothing to do)."""
+    if not group2ctx:
+        return None
+    if mesh is None:
+        from .mesh import current_mesh
+        mesh = current_mesh()
+    gp = GroupPlacement(group2ctx, mesh)
+    if gp.mesh is None:
+        return None
+    return gp

@@ -52,7 +52,8 @@ class TrainStep(object):
                  label_names=("softmax_label",), optimizer="sgd",
                  learning_rate=0.01, momentum=0.9, wd=0.0, rescale_grad=None,
                  mesh=None, param_shardings=None, dtype=np.float32,
-                 compute_dtype=None, remat=False, frozen_param_names=None):
+                 compute_dtype=None, remat=False, frozen_param_names=None,
+                 group2ctx=None):
         self.symbol = symbol
         self.data_names = list(data_names)
         self.label_names = list(label_names)
@@ -92,7 +93,21 @@ class TrainStep(object):
             self.compute_dtype = self.dtype
         else:
             self.compute_dtype = None
-        self._run, self._nodes = _build_graph_runner(symbol)
+        # ctx_group model parallelism: lower group annotations to sharding
+        # constraints inside the step, and default each grouped parameter's
+        # sharding from its group spec (explicit param_shardings win)
+        from .parallel import placement as _placement
+        self._placement = _placement.resolve(group2ctx, mesh)
+        self._run, self._nodes = _build_graph_runner(symbol, self._placement)
+        if self._placement is not None:
+            if self.mesh is None:
+                self.mesh = self._placement.mesh
+            pgroups = _placement.param_groups(self._nodes)
+            self._auto_group_params = {
+                n: g for n, g in pgroups.items() if n in self.param_names
+                and n not in self.param_shardings}
+        else:
+            self._auto_group_params = {}
         self._needs_rng = any((not n.is_variable) and n.op.needs_rng
                               for n in self._nodes)
         if remat:
@@ -152,15 +167,23 @@ class TrainStep(object):
                 if n not in self.frozen_param_names}
 
     # ------------------------------------------------------------------
-    def _param_spec(self, name):
-        return self.param_shardings.get(name, P())
+    def _param_spec(self, name, shape=None):
+        if name in self.param_shardings:
+            return self.param_shardings[name]
+        g = self._auto_group_params.get(name)
+        if g is not None and shape is not None:
+            spec = self._placement.param_spec(g, tuple(shape))
+            if spec is not None:
+                return spec
+        return P()
 
     def _shard_state(self, state):
         mesh = self.mesh
 
         def put_params(tree):
             return {n: jax.device_put(
-                v, jax.sharding.NamedSharding(mesh, self._param_spec(n)))
+                v, jax.sharding.NamedSharding(mesh,
+                                              self._param_spec(n, v.shape)))
                 for n, v in tree.items()}
 
         out = dict(state)
@@ -169,7 +192,8 @@ class TrainStep(object):
         out["opt"] = {
             n: jax.tree_util.tree_map(
                 lambda v, _n=n: jax.device_put(
-                    v, jax.sharding.NamedSharding(mesh, self._param_spec(_n))),
+                    v, jax.sharding.NamedSharding(
+                        mesh, self._param_spec(_n, v.shape))),
                 st)
             for n, st in state["opt"].items()}
         repl = jax.sharding.NamedSharding(mesh, P())
