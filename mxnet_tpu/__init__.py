@@ -49,6 +49,7 @@ from . import operator   # registers the Custom op type
 from . import c_api
 from . import rtc
 from . import kvstore_server
+from .kvstore_server import _init_distributed as tools_init_distributed
 from . import predictor
 from .predictor import Predictor
 # refresh op-function namespaces so late registrations (Custom) appear

@@ -72,18 +72,24 @@ class Context(object):
     # JAX device resolution
     # ------------------------------------------------------------------
     def to_device(self):
-        """Resolve this context to a concrete jax.Device."""
+        """Resolve this context to a concrete jax.Device.
+
+        Contexts name devices of THIS process (jax.local_devices): in a
+        multi-process (dist_sync) job, each worker's cpu(0)/tpu(0) is its own
+        chip — the reference semantics, where device ids are per-worker
+        (ref: kvstore_dist.h worker-local device lists)."""
         import jax
         dt = self.device_type
         if dt == "cpu" or dt == "cpu_pinned":
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = (_local_platform_devices("cpu")
+                    or jax.local_devices())
             # context ids beyond physical devices are legal for CPU in the
             # reference (SURVEY.md section 4 multi-device trick); clamp by modulo.
             return devs[self.device_id % len(devs)]
         # tpu / gpu alias -> whatever accelerator platform is default
         devs = _accelerator_devices()
         if not devs:
-            devs = jax.devices()
+            devs = jax.local_devices()
         if self.device_id >= len(devs):
             return devs[self.device_id % len(devs)]
         return devs[self.device_id]
@@ -94,19 +100,19 @@ class Context(object):
         return jax.sharding.SingleDeviceSharding(self.to_device())
 
 
-def _has_platform(name):
+def _local_platform_devices(name):
     import jax
     try:
-        return bool(jax.devices(name))
+        return [d for d in jax.local_devices() if d.platform == name]
     except RuntimeError:
-        return False
+        return []
 
 
 def _accelerator_devices():
-    """All non-cpu devices, else cpu devices."""
+    """This process's non-cpu devices, else its cpu devices."""
     import jax
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs if devs else jax.devices("cpu")
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return devs if devs else _local_platform_devices("cpu")
 
 
 def cpu(device_id=0):
@@ -138,7 +144,7 @@ def current_context():
     if not hasattr(Context._default_ctx, "value"):
         import jax
         try:
-            accel = [d for d in jax.devices() if d.platform != "cpu"]
+            accel = [d for d in jax.local_devices() if d.platform != "cpu"]
         except Exception:
             accel = []
         Context._default_ctx.value = Context("tpu", 0) if accel else Context("cpu", 0)

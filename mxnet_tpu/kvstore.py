@@ -55,7 +55,17 @@ class KVStore(object):
         for k, vlist in zip(keys, values):
             if k in self._store:
                 raise MXNetError("init: key %r already initialized" % (k,))
-            self._store[k] = vlist[0].copy()
+            self._store[k] = self._init_value(vlist[0].copy())
+
+    def _init_value(self, value):
+        """Hook: dist stores broadcast rank 0's copy so every worker starts
+        from ONE authoritative value (ref: the server's single stored
+        weight, kvstore_dist_server.h)."""
+        return value
+
+    def _cross_reduce(self, merged):
+        """Hook: dist stores sum the locally-reduced value across workers."""
+        return merged
 
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
@@ -67,6 +77,7 @@ class KVStore(object):
             merged = vlist[0].data
             for v in vlist[1:]:
                 merged = merged + v.data
+            merged = self._cross_reduce(merged)
             merged_nd = NDArray(merged)
             if self._updater is not None:
                 self._updater(k, merged_nd, self._store[k])
@@ -131,6 +142,8 @@ class KVStoreDistSync(KVStore):
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
         self._rank, self._size = _dist_rank_size()
+        self._gmesh = None
+        self._sum_fn = None
 
     @property
     def rank(self):
@@ -147,6 +160,52 @@ class KVStoreDistSync(KVStore):
             multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
 
     barrier = _barrier
+
+    # ------------------------------------------------------------------
+    def _cross_sum(self, value):
+        """Sum a host value across all worker processes (the ps-lite server
+        aggregation, ref kvstore_dist_server.h:164-198, as one XLA
+        reduction over the global device mesh). BSP contract: every worker
+        must call push with the same keys in the same order."""
+        if self._size == 1:
+            return value
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self._gmesh is None:
+            from .parallel.mesh import global_data_mesh
+            self._gmesh = global_data_mesh("worker")
+        if self._sum_fn is None:
+            repl = NamedSharding(self._gmesh, P())
+            self._sum_fn = jax.jit(lambda a: jnp.sum(a, axis=0),
+                                   out_shardings=repl)
+        sharded = NamedSharding(self._gmesh, P("worker"))
+        local = np.asarray(value)
+        n_local = jax.local_device_count()
+        # one (replicated) slot per local device along the summed axis;
+        # scale so the global sum still counts each worker exactly once
+        tile = np.broadcast_to(local / n_local, (n_local,) + local.shape)
+        garr = jax.make_array_from_process_local_data(sharded, tile)
+        out = self._sum_fn(garr)
+        return jnp.asarray(np.asarray(out))
+
+    # the cross-worker aggregation slots into the base push/init via hooks:
+    # every worker applies the identical updater to the identical aggregate
+    # of one authoritative initial value, so replicas never diverge
+    def _cross_reduce(self, merged):
+        return self._cross_sum(merged)
+
+    def _init_value(self, value):
+        if self._size == 1:
+            return value
+        import jax.numpy as jnp
+        from .parallel.mesh import global_data_mesh, host_broadcast0
+        if self._gmesh is None:
+            self._gmesh = global_data_mesh("worker")
+        value._set_data(jnp.asarray(host_broadcast0(self._gmesh,
+                                                    value.data)))
+        return value
 
 
 def _dist_rank_size():

@@ -366,8 +366,6 @@ class Module(BaseModule):
             return False
         if self.inputs_need_grad or self._state_names:
             return False
-        if self._kvstore is not None and "dist" in self._kvstore.type:
-            return False
         if not getattr(self._optimizer, "fused_supported", False):
             return False
         eg = self._exec_group
@@ -381,15 +379,31 @@ class Module(BaseModule):
         eg = self._exec_group
         frozen = [n for n in eg.param_names
                   if eg.grad_req.get(n, "null") == "null"]
+        mesh = eg._mesh
+        if (self._kvstore is not None and "dist" in self._kvstore.type
+                and self._kvstore.num_workers > 1):
+            # dist_sync INSIDE the fused step: the batch shards over a
+            # global mesh spanning every worker process and XLA places the
+            # gradient psum over DCN/ICI exactly where the reference ran
+            # ps-lite push/pull (ref: kvstore_dist.h sync mode)
+            from ..parallel.mesh import global_data_mesh
+            mesh = global_data_mesh(
+                local_devices=[c.to_device() for c in self._context])
         self._fused = TrainStep(
             self._symbol, data_names=eg.data_names,
             label_names=eg.label_names, optimizer=self._optimizer,
-            mesh=eg._mesh, frozen_param_names=frozen)
+            mesh=mesh, frozen_param_names=frozen)
         self._fused_state = self._seed_fused_state()
         self._fused_params_stale = False
 
     def _jnp_copy(self, x):
         import jax.numpy as jnp
+        if not getattr(x, "is_fully_addressable", True):
+            # multi-host global array -> process-local copy (params/aux are
+            # replicated in dist DP, so the local copy is the full value and
+            # the executor's single-device jit can consume it)
+            from ..parallel.mesh import local_view
+            return jnp.copy(local_view(x))
         return jnp.copy(x)
 
     def _seed_fused_state(self, prev=None):
@@ -455,15 +469,25 @@ class Module(BaseModule):
             self._fused_state = self._seed_fused_state(prev=self._fused_state)
             self._fused_params_stale = False
         eg = self._exec_group
+        from ..parallel.mesh import is_multiprocess, local_view
+        multiproc = is_multiprocess(self._fused.mesh)
         batch = {}
         for name, value in zip(eg.data_names, data_batch.data):
-            batch[name] = eg._shard_batch(value)
+            batch[name] = value if multiproc else eg._shard_batch(value)
         if eg.label_names and data_batch.label:
             for name, value in zip(eg.label_names, data_batch.label):
-                batch[name] = eg._shard_batch(value)
+                batch[name] = value if multiproc else eg._shard_batch(value)
+        if multiproc:
+            # each worker contributes its local shard of the global batch
+            import numpy as _np
+            batch = self._fused.shard_batch(
+                {k: _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+                 for k, v in batch.items()})
         from ..ndarray import NDArray
         self._fused_state, outs = self._fused.step(self._fused_state, batch)
-        self._fused_outputs = [NDArray(o) for o in outs]
+        # per-worker view of batch-sharded outputs (each worker's metric
+        # covers its own shard, matching reference per-worker eval)
+        self._fused_outputs = [NDArray(local_view(o)) for o in outs]
         self._fused_dirty = True
         self._params_dirty = True
         return True

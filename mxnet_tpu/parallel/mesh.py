@@ -88,6 +88,80 @@ def shard_batch(tree, mesh, axis=AXIS_DATA):
     return jax.tree_util.tree_map(put, tree)
 
 
+def is_multiprocess(mesh):
+    """True when the mesh spans more than one jax process (multi-host)."""
+    if mesh is None:
+        return False
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def global_data_mesh(axis_name=AXIS_DATA, local_devices=None):
+    """Mesh over devices of ALL processes along one data axis — the
+    dist_sync substrate: batch shards ride 'data' across hosts and XLA's
+    gradient psum rides DCN/ICI (the ps-lite replacement, SURVEY §2.4).
+
+    ``local_devices`` restricts the mesh to the given devices of THIS
+    process plus the same positions on every other process (workers are
+    assumed symmetric — the reference's assumption too: every worker runs
+    the same script with the same device list)."""
+    devices = jax.devices()  # global list, all processes
+    if local_devices is not None:
+        mine = jax.local_devices()
+        keep = sorted({mine.index(d) for d in local_devices})
+        by_proc = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        devices = [p_devs[i] for _, p_devs in sorted(by_proc.items())
+                   for i in keep if i < len(p_devs)]
+    return jax.sharding.Mesh(np.array(devices), (axis_name,))
+
+
+def host_to_global(mesh, spec, local_value):
+    """Build a global jax.Array from per-process host data.
+
+    For dims sharded across processes ``local_value`` is THIS process's
+    portion (e.g. its batch shard); for replicated specs every process
+    passes the same full value.
+    """
+    s = jax.sharding.NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(s, np.asarray(local_value))
+
+
+def host_broadcast0(mesh, value):
+    """Broadcast rank-0's host value to every process (returns a host
+    array): the dist kvstore init semantics — one authoritative copy, like
+    the reference server's single stored weight (ref: kvstore_dist_server.h).
+    Implemented as a masked global sum so it rides the same collective path
+    as everything else."""
+    import jax.numpy as jnp
+    me = jax.process_index()
+    n_local = sum(1 for d in mesh.devices.flat if d.process_index == me)
+    local = np.asarray(value)
+    contrib = (local / n_local if me == 0
+               else np.zeros_like(local))
+    tile = np.broadcast_to(contrib, (n_local,) + local.shape)
+    axis = mesh.axis_names[0]
+    sharded = jax.sharding.NamedSharding(mesh, P(axis))
+    repl = jax.sharding.NamedSharding(mesh, P())
+    garr = jax.make_array_from_process_local_data(sharded, tile)
+    out = jax.jit(lambda a: jnp.sum(a, axis=0), out_shardings=repl)(garr)
+    return np.asarray(out)
+
+
+def local_view(arr):
+    """This process's slice of a global array, as one host-order array
+    (the per-worker view of batch-sharded outputs: each worker computes
+    metrics on its own shard, like the reference's per-worker eval)."""
+    import jax.numpy as jnp
+    if getattr(arr, "is_fully_addressable", True):
+        return arr
+    if arr.is_fully_replicated:
+        return jnp.asarray(np.asarray(arr))
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: [sl.start or 0 for sl in s.index])
+    return jnp.concatenate([s.data for s in shards], axis=0)
+
+
 def grad_sync(grads, axis_name=AXIS_DATA):
     """Explicit gradient all-reduce for shard_map-style training steps —
     the dist_sync kv.push+pull semantics as one psum over ICI

@@ -114,6 +114,9 @@ class TrainStep(object):
             self._run = self._wrap_remat(self._run)
         self._jit = {}  # keyed by batch size (rescale_grad depends on it)
         self._base_key = None  # drawn lazily from the global seeded stream
+        # host-side step clock for RNG folding: state["step"] may be a
+        # multi-host global array that host code cannot read
+        self._host_step = 0
 
     # ------------------------------------------------------------------
     def _wrap_remat(self, run):
@@ -179,33 +182,52 @@ class TrainStep(object):
 
     def _shard_state(self, state):
         mesh = self.mesh
+        # multi-host mesh: device_put cannot target non-addressable devices;
+        # assemble global arrays from (identical) per-process host copies
+        from .parallel.mesh import (is_multiprocess, host_to_global,
+                                    host_broadcast0)
+        if is_multiprocess(mesh):
+            def put(v, spec):
+                if spec == P():
+                    # replicated state must be CONSISTENT across workers
+                    # even if their host copies diverged (e.g. per-rank
+                    # seeding): rank 0's copy is authoritative, like the
+                    # reference server's single stored weight
+                    v = host_broadcast0(mesh, v)
+                return host_to_global(mesh, spec, v)
+        else:
+            def put(v, spec):
+                return jax.device_put(
+                    v, jax.sharding.NamedSharding(mesh, spec))
 
         def put_params(tree):
-            return {n: jax.device_put(
-                v, jax.sharding.NamedSharding(mesh,
-                                              self._param_spec(n, v.shape)))
-                for n, v in tree.items()}
+            return {n: put(v, self._param_spec(n, v.shape))
+                    for n, v in tree.items()}
 
         out = dict(state)
         out["params"] = put_params(state["params"])
         # optimizer state pytrees shard exactly like their weight
         out["opt"] = {
             n: jax.tree_util.tree_map(
-                lambda v, _n=n: jax.device_put(
-                    v, jax.sharding.NamedSharding(
-                        mesh, self._param_spec(_n, v.shape))),
-                st)
+                lambda v, _n=n: put(v, self._param_spec(_n, v.shape)), st)
             for n, st in state["opt"].items()}
-        repl = jax.sharding.NamedSharding(mesh, P())
-        out["aux"] = {n: jax.device_put(v, repl)
-                      for n, v in state["aux"].items()}
-        out["step"] = jax.device_put(state["step"], repl)
+        out["aux"] = {n: put(v, P()) for n, v in state["aux"].items()}
+        out["step"] = put(state["step"], P())
         return out
 
     def shard_batch(self, batch):
-        """device_put batch arrays with dim-0 sharded along the data axis."""
+        """Place batch arrays with dim-0 sharded along the data axis.
+
+        On a multi-host mesh each process passes its LOCAL batch shard and
+        the global batch is their concatenation — the dist_sync data
+        partition (ref: kvstore num_workers/rank feeding ImageRecordIter
+        part_index/num_parts)."""
         if self.mesh is None:
             return batch
+        from .parallel.mesh import is_multiprocess, host_to_global
+        if is_multiprocess(self.mesh):
+            return {k: host_to_global(self.mesh, P("data"), v)
+                    for k, v in batch.items()}
         s = jax.sharding.NamedSharding(self.mesh, P("data"))
         return {k: jax.device_put(jnp.asarray(v), s) for k, v in batch.items()}
 
@@ -278,9 +300,10 @@ class TrainStep(object):
             # noise; per-step keys fold in the step counter
             if self._base_key is None:
                 self._base_key = _random.split()
-            key = jax.random.fold_in(self._base_key, state["step"])
+            key = jax.random.fold_in(self._base_key, self._host_step)
         else:
             key = jax.random.key(0)  # static; unused ops ignore it
+        self._host_step += 1
         # scheduler clock advances host-side; lr rides in as a traced scalar
         self._opt.num_update += 1
         if self._opt.lr_scheduler is not None:

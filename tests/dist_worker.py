@@ -1,0 +1,146 @@
+"""Worker program for the multi-process dist_sync tests.
+
+Spawned by tests/test_dist_sync.py through tools/launch.py (the reference's
+local tracker path, ref: tools/launch.py:46-78 + tests/nightly/
+dist_sync_kvstore.py:30-45 + dist_lenet.py). Runs on the CPU backend with
+one device per process; gradient aggregation crosses processes via Gloo.
+
+Modes:
+  kvstore — closed-form BSP push/pull assertions (every worker pushes a
+            known value; the aggregate is exactly computable)
+  lenet   — Module.fit with kvstore='dist_sync' on rank-partitioned
+            synthetic data; asserts accuracy, the in-step-psum fused path,
+            and cross-worker parameter consistency
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ.pop("XLA_FLAGS", None)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    mode = sys.argv[1]
+    import mxnet_tpu as mx
+    assert mx.tools_init_distributed(), "MXTPU_* env missing"
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    assert nproc >= 2, "dist test needs >= 2 processes"
+
+    if mode == "kvstore":
+        run_kvstore(mx, rank, nproc)
+    elif mode == "lenet":
+        run_lenet(mx, rank, nproc)
+    else:
+        raise SystemExit("unknown mode %r" % mode)
+    print("RANK-%d-PASS" % rank, flush=True)
+
+
+def run_kvstore(mx, rank, nproc):
+    """Closed-form BSP semantics (ref: dist_sync_kvstore.py:30-45)."""
+    from mxnet_tpu import nd
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == rank and kv.num_workers == nproc
+    shape = (3, 4)
+
+    # no-updater push: store <- sum over workers of (rank+1)
+    kv.init(3, nd.ones(shape))
+    kv.push(3, nd.ones(shape) * (rank + 1))
+    out = nd.zeros(shape)
+    kv.pull(3, out=out)
+    expect = sum(r + 1 for r in range(nproc))
+    np.testing.assert_allclose(out.asnumpy(), expect * np.ones(shape))
+
+    # updater path: store += aggregated push, repeated (the reference's
+    # accumulation check)
+    kv2 = mx.kv.create("dist_sync")
+    kv2._set_updater(lambda key, recv, stored: stored.__iadd__(recv))
+    kv2.init("acc", nd.zeros(shape))
+    nrepeat = 3
+    for i in range(nrepeat):
+        kv2.push("acc", nd.ones(shape) * (rank + 1))
+    o = nd.zeros(shape)
+    kv2.pull("acc", out=o)
+    np.testing.assert_allclose(o.asnumpy(),
+                               nrepeat * expect * np.ones(shape))
+
+    # multi-device local list push combines with cross-worker reduce
+    kv3 = mx.kv.create("dist_sync")
+    kv3.init(9, nd.zeros(shape))
+    kv3.push(9, [nd.ones(shape) * (rank + 1), nd.ones(shape) * (rank + 1)])
+    o3 = nd.zeros(shape)
+    kv3.pull(9, out=o3)
+    np.testing.assert_allclose(o3.asnumpy(), 2 * expect * np.ones(shape))
+
+    # workers whose host values diverged (per-rank seeding) must still
+    # start from ONE authoritative copy: init broadcasts rank 0's value
+    kv4 = mx.kv.create("dist_sync")
+    kv4.init("b", nd.ones(shape) * (rank + 1) * 10)
+    o4 = nd.zeros(shape)
+    kv4.pull("b", out=o4)
+    np.testing.assert_allclose(o4.asnumpy(), 10 * np.ones(shape))
+
+    kv.barrier()
+
+
+def run_lenet(mx, rank, nproc):
+    """Distributed training to accuracy (ref: dist_lenet.py / test_mlp)."""
+    from mxnet_tpu.io import NDArrayIter
+
+    # rank-partitioned separable data: class templates + noise
+    n_class, dim, n_per = 8, 32, 256
+    rng = np.random.RandomState(7)  # same on all ranks
+    templates = rng.randn(n_class, dim).astype(np.float32) * 3
+    labels_all = np.arange(n_class * n_per) % n_class
+    x_all = (templates[labels_all]
+             + rng.randn(len(labels_all), dim).astype(np.float32) * 0.5)
+    # each worker sees ONLY its shard (ref: part_index/num_parts)
+    x, y = x_all[rank::nproc], labels_all[rank::nproc].astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    h = mx.sym.Activation(h, name="relu1", act_type="relu")
+    # dropout exercises RNG threading through the multi-host fused step
+    h = mx.sym.Dropout(h, name="drop1", p=0.2)
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=n_class)
+    out = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    mod = mx.mod.Module(out)
+    train = NDArrayIter(x, y, batch_size=64, shuffle=False)
+    mod.fit(train, num_epoch=8, kvstore="dist_sync",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+
+    # the dist bail-out is gone: fit must have used the fused in-step-psum
+    # path over the global mesh
+    assert mod._fused is not None, "dist fit fell back to the slow path"
+    from mxnet_tpu.parallel.mesh import is_multiprocess
+    assert is_multiprocess(mod._fused.mesh), "fused step not multi-host"
+
+    score = mod.score(NDArrayIter(x, y, batch_size=64), "acc")
+    acc = dict(score)["accuracy"]
+    assert acc >= 0.95, "rank %d accuracy %.3f < 0.95" % (rank, acc)
+
+    # replicas must not diverge: params bitwise identical across workers
+    arg_params, _ = mod.get_params()
+    blob = np.concatenate([arg_params[k].asnumpy().ravel()
+                           for k in sorted(arg_params)])
+    kv = mx.kv.create("dist_sync")  # fresh store: no updater installed
+    mine = mx.nd.array(blob)
+    tot = mx.nd.zeros(blob.shape)
+    kv.init("paramcheck", tot)
+    kv.push("paramcheck", mine)
+    kv.pull("paramcheck", out=tot)
+    np.testing.assert_allclose(tot.asnumpy(), nproc * blob, rtol=1e-6,
+                               err_msg="worker replicas diverged")
+
+
+if __name__ == "__main__":
+    main()

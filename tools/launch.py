@@ -60,14 +60,16 @@ def main():
     parser.add_argument("--launcher", choices=["local", "ssh"],
                         default="local")
     parser.add_argument("--host-file", default=None)
+    parser.add_argument("--coord-port", type=int, default=12421,
+                        help="jax.distributed coordinator port")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     command = " ".join(args.command)
     if args.launcher == "local":
-        sys.exit(launch_local(args.num_workers, command))
+        sys.exit(launch_local(args.num_workers, command, args.coord_port))
     else:
         assert args.host_file, "ssh launcher needs --host-file"
-        sys.exit(launch_ssh(args.host_file, command))
+        sys.exit(launch_ssh(args.host_file, command, args.coord_port))
 
 
 if __name__ == "__main__":
