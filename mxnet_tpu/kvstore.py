@@ -183,9 +183,11 @@ class KVStoreDistSync(KVStore):
         sharded = NamedSharding(self._gmesh, P("worker"))
         local = np.asarray(value)
         n_local = jax.local_device_count()
-        # one (replicated) slot per local device along the summed axis;
-        # scale so the global sum still counts each worker exactly once
-        tile = np.broadcast_to(local / n_local, (n_local,) + local.shape)
+        # the worker's value rides its FIRST device slot, zeros elsewhere —
+        # the sum counts each worker exactly once with no dtype-changing
+        # division (integer pushes stay integers)
+        zero = np.zeros_like(local)
+        tile = np.stack([local if j == 0 else zero for j in range(n_local)])
         garr = jax.make_array_from_process_local_data(sharded, tile)
         out = self._sum_fn(garr)
         return jnp.asarray(np.asarray(out))

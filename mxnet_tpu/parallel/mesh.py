@@ -137,9 +137,11 @@ def host_broadcast0(mesh, value):
     me = jax.process_index()
     n_local = sum(1 for d in mesh.devices.flat if d.process_index == me)
     local = np.asarray(value)
-    contrib = (local / n_local if me == 0
-               else np.zeros_like(local))
-    tile = np.broadcast_to(contrib, (n_local,) + local.shape)
+    # only rank 0's FIRST device slot contributes the value — no division,
+    # so integer dtypes survive and every rank builds the same-typed array
+    zero = np.zeros_like(local)
+    tile = np.stack([local if (me == 0 and j == 0) else zero
+                     for j in range(n_local)])
     axis = mesh.axis_names[0]
     sharded = jax.sharding.NamedSharding(mesh, P(axis))
     repl = jax.sharding.NamedSharding(mesh, P())

@@ -46,6 +46,18 @@ def _load_native():
         _NATIVE = False
         return None
     lib = ctypes.CDLL(so)
+    if not hasattr(lib, "mxtpu_img_decode_batch"):
+        # stale prebuilt .so from before the image-decode engine existed:
+        # rebuild once, then reload; give up (Pillow fallback) on failure
+        try:
+            subprocess.run(["make", "-C", os.path.join(root, "src"), "-B"],
+                           check=True, capture_output=True)
+            lib = ctypes.CDLL(so)
+        except Exception:
+            pass
+        if not hasattr(lib, "mxtpu_img_decode_batch"):
+            _NATIVE = False
+            return None
     lib.mxtpu_rio_open.restype = ctypes.c_void_p
     lib.mxtpu_rio_open.argtypes = [ctypes.c_char_p]
     lib.mxtpu_rio_next.restype = ctypes.POINTER(ctypes.c_char)
@@ -62,6 +74,19 @@ def _load_native():
     lib.mxtpu_rio_prefetch_next.restype = ctypes.c_int64
     lib.mxtpu_rio_prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                             ctypes.c_uint64]
+    # fused JPEG decode+augment+batch (src/io/image_decode.cc)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.mxtpu_img_decode_batch.restype = ctypes.c_int
+    lib.mxtpu_img_decode_batch.argtypes = [
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, f32p, f32p, f32p, ctypes.POINTER(ctypes.c_int8),
+        ctypes.c_int]
+    lib.mxtpu_img_decode_one.restype = ctypes.c_int
+    lib.mxtpu_img_decode_one.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_int, u8p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     _NATIVE = lib
     return lib
 
@@ -266,10 +291,18 @@ class MXIndexedRecordIO(MXRecordIO):
 
 
 def pack(header, s):
-    """Pack a string with IRHeader (ref: recordio.py pack)."""
+    """Pack a string with IRHeader (ref: recordio.py pack). An array label
+    (the detection format) is stored after the header with flag carrying
+    its length, mirroring unpack()."""
     if not isinstance(header, IRHeader):
         header = IRHeader(*header)
-    buf = struct.pack(IRHeader_FMT, header.flag, header.label, header.id,
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)):
+        arr = np.asarray(label, dtype=np.float32).reshape(-1)
+        buf = struct.pack(IRHeader_FMT, len(arr), 0.0, header.id,
+                          header.id2)
+        return buf + arr.tobytes() + s
+    buf = struct.pack(IRHeader_FMT, header.flag, float(label), header.id,
                       header.id2)
     return buf + s
 
