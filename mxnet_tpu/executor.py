@@ -155,6 +155,11 @@ class Executor(object):
             self._grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
         for n in self.arg_names:
             if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                if not jnp.issubdtype(self.arg_dict[n].data.dtype,
+                                      jnp.floating):
+                    # integer inputs have no gradient (reference kNullOp)
+                    self._grad_req[n] = "null"
+                    continue
                 raise MXNetError("grad_req %r for %s but no grad array bound"
                                  % (self._grad_req[n], n))
 
@@ -433,7 +438,15 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
         raise MXNetError("simple_bind: cannot infer shapes from %r" % kwargs)
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
-    type_dict = type_dict or {}
+    # complete dtypes through the graph: one typed input (bf16 data, int32
+    # label) types every parameter the way the reference's InferType pass
+    # does (ref: c_api_symbolic.cc infer-type; tests/python/train/test_dtype)
+    type_dict = dict(type_dict or {})
+    arg_types, _out_t, aux_types = symbol.infer_type_partial(**type_dict)
+    for n, t in zip(arg_names, arg_types):
+        if n not in type_dict and t is not None:
+            type_dict[n] = t
+    aux_type_of = dict(zip(aux_names, aux_types))
 
     # group2ctx: allocate each group's parameters SHARDED over the mesh so
     # weight memory distributes across devices (the capacity win that
@@ -472,16 +485,17 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
         req = grad_req if isinstance(grad_req, str) else (
             grad_req[arg_names.index(n)] if isinstance(grad_req, (list, tuple))
             else grad_req.get(n, "null"))
-        if req != "null":
+        # integer inputs (labels, lookup ids) carry no gradient, matching
+        # the reference's kNullOp for non-float storage types
+        if req != "null" and np.issubdtype(dt, np.floating):
             sg = _shared(shared_exec.grad_dict if shared_exec else {}, n, sh,
                          dt)
             grads[n] = sg if sg is not None else _alloc(n, sh, dt)
     aux = {}
     for n, sh in zip(aux_names, aux_shapes):
-        sa = _shared(shared_exec.aux_dict if shared_exec else {}, n, sh,
-                     np.dtype(np.float32))
-        aux[n] = sa if sa is not None else NDArray(
-            jnp.zeros(sh, np.dtype(np.float32)))
+        adt = np.dtype(aux_type_of.get(n) or np.float32)
+        sa = _shared(shared_exec.aux_dict if shared_exec else {}, n, sh, adt)
+        aux[n] = sa if sa is not None else NDArray(jnp.zeros(sh, adt))
     return Executor(symbol, ctx, args, grads or None, grad_req, aux,
                     group2ctx=gp if gp is not None else group2ctx,
                     shared_exec=shared_exec)

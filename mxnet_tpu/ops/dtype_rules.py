@@ -1,0 +1,80 @@
+"""Per-op dtype-inference rules (ref: the reference's per-op InferType
+functions, src/operator/*-inl.h and nnvm ElemwiseType overrides).
+
+Most ops follow the default "one dtype everywhere" rule in
+OpDef.infer_type; this module attaches the exceptions after all ops have
+registered (imported at the end of ops/__init__):
+
+- Cast: output dtype is the attribute, input free.
+- one_hot / sampling ops: output dtype from the ``dtype`` attr (default
+  float32), indices keep their own (integer labels flow through).
+- Embedding: lookup indices keep their own dtype (int32/float both legal,
+  like the reference's float-id convention); weight/output share a float
+  dtype.
+- Loss heads (SoftmaxOutput family): the label input keeps its own dtype —
+  int32 labels against bf16/f32 logits — outputs follow the data.
+- where: the condition keeps its own dtype; x/y/output unify.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import registry as _reg
+
+_F32 = np.dtype(np.float32)
+
+
+def _set(name, fn):
+    if _reg.exists(name):
+        _reg.get(name)._infer_type = fn
+
+
+def _cast_type(attrs, ins):
+    return [ins[0]], [np.dtype(str(attrs.get("dtype", "float32")))], []
+
+
+def _attr_dtype_out(attrs, ins):
+    dt = np.dtype(str(attrs.get("dtype", "float32")))
+    return list(ins), [dt], []
+
+
+def _embedding_type(attrs, ins):
+    data, weight = ins[0], ins[1]
+    if weight is None:
+        # indices may be integer; the table itself is float
+        weight = data if (data is not None
+                          and np.issubdtype(data, np.floating)) else _F32
+    return [data, weight], [weight], []
+
+
+def _label_free_loss(n_out=1):
+    def rule(attrs, ins):
+        data = ins[0]
+        full = [data] + [i if i is not None else data for i in ins[1:]]
+        return full, [data] * n_out, []
+    return rule
+
+
+def _where_type(attrs, ins):
+    cond = ins[0]
+    known = [d for d in ins[1:] if d is not None]
+    dt = known[0] if known else None
+    return [cond, dt, dt], [dt], []
+
+
+def install():
+    _set("Cast", _cast_type)
+    _set("one_hot", _attr_dtype_out)
+    for s in ("_sample_uniform", "_sample_normal", "_sample_gamma",
+              "_sample_exponential", "_sample_poisson",
+              "_sample_negbinomial"):
+        _set(s, _attr_dtype_out)
+    _set("Embedding", _embedding_type)
+    for loss in ("SoftmaxOutput", "LinearRegressionOutput",
+                 "LogisticRegressionOutput", "MAERegressionOutput",
+                 "SVMOutput"):
+        _set(loss, _label_free_loss(1))
+    _set("where", _where_type)
+
+
+install()

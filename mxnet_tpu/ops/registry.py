@@ -109,12 +109,16 @@ class OpDef(object):
         return list(in_shapes), [tuple(o.shape) for o in outs], []
 
     def infer_type(self, attrs, in_dtypes):
+        """Complete dtypes (ref: nnvm InferType; default = the reference's
+        ElemwiseType rule: all inputs/outputs share one dtype). Unknown
+        inputs inherit the first known dtype; already-known inputs are kept
+        (a genuine conflict surfaces in the Symbol pass)."""
         if self._infer_type is not None:
             return self._infer_type(attrs, list(in_dtypes))
         known = [d for d in in_dtypes if d is not None]
-        dt = known[0] if known else _np.float32
-        n_in = len(in_dtypes)
-        return ([dt] * n_in,
+        dt = known[0] if known else None
+        full_in = [d if d is not None else dt for d in in_dtypes]
+        return (full_in,
                 [dt] * self.num_outputs(attrs),
                 [dt] * len(self._aux))
 

@@ -381,6 +381,18 @@ class Symbol(object):
         return arg_out, out_shapes, aux_out
 
     def infer_type(self, *args, **kwargs):
+        """Forward dtype propagation over the DAG
+        (ref: nnvm InferType pass, src/c_api/c_api_symbolic.cc infer-type;
+        per-op rules live on OpDef.infer_type). Unknown leaf dtypes default
+        to float32 AFTER propagation, so a single typed input (e.g. bf16
+        data, int32 label) types the whole network the way the reference's
+        backward+forward type pass does."""
+        return self._infer_type_impl(False, *args, **kwargs)
+
+    def infer_type_partial(self, *args, **kwargs):
+        return self._infer_type_impl(True, *args, **kwargs)
+
+    def _infer_type_impl(self, partial, *args, **kwargs):
         arg_names = self.list_arguments()
         known = {}
         if args:
@@ -388,12 +400,57 @@ class Symbol(object):
                 if t is not None:
                     known[n] = np.dtype(t)
         for k, v in kwargs.items():
-            known[k] = np.dtype(v)
-        # default float32 propagation; special int ops handled per-op later
-        arg_types = [known.get(n, np.dtype(np.float32)) for n in arg_names]
-        out_types = [np.dtype(np.float32)] * len(self.list_outputs())
-        aux_types = [np.dtype(np.float32)] * len(self.list_auxiliary_states())
-        return arg_types, out_types, aux_types
+            if v is not None:
+                known[k] = np.dtype(v)
+        node_out = {}       # (id(node), idx) -> dtype | None
+        var_types = dict(known)
+        aux_types = {}
+        # two passes: the second lets parameter dtypes settled by one layer
+        # (e.g. shared weights, or data typed via a downstream op) reach
+        # layers visited earlier — the cheap fixed-point of nnvm's pass
+        for _sweep in range(2):
+            for node in _topo(self._out_nodes()):
+                if node.is_variable:
+                    dt = var_types.get(node.name)
+                    if dt is None and "__dtype__" in node._user_attr:
+                        dt = np.dtype(node._user_attr["__dtype__"])
+                        var_types[node.name] = dt
+                    node_out[(id(node), 0)] = dt
+                    continue
+                in_types = [node_out.get((id(inp), idx))
+                            for (inp, idx) in node.inputs]
+                try:
+                    full_in, outs, aux = node.op.infer_type(node.attrs,
+                                                            in_types)
+                except MXNetError:
+                    if partial:
+                        for i in range(node.num_outputs()):
+                            node_out[(id(node), i)] = None
+                        continue
+                    raise
+                for (inp, idx), dt in zip(node.inputs, full_in):
+                    if inp.is_variable and dt is not None:
+                        prev = var_types.get(inp.name)
+                        if prev is not None and np.dtype(prev) != np.dtype(dt):
+                            raise MXNetError(
+                                "type mismatch for %s: %s vs %s"
+                                % (inp.name, prev, dt))
+                        var_types[inp.name] = np.dtype(dt)
+                        node_out[(id(inp), 0)] = np.dtype(dt)
+                for i, dt in enumerate(outs):
+                    node_out[(id(node), i)] = (np.dtype(dt)
+                                               if dt is not None else None)
+                for aname, adt in zip(node.op.list_aux(node.attrs), aux):
+                    aux_types["%s_%s" % (node.name, aname)] = (
+                        np.dtype(adt) if adt is not None else None)
+        f32 = np.dtype(np.float32)
+        arg_out = [var_types.get(n) or (None if partial else f32)
+                   for n in arg_names]
+        out_types = [node_out.get((id(n), i)) or (None if partial else f32)
+                     for n, i in self._outputs]
+        aux_out = [aux_types.get(a) or (None if partial else f32)
+                   for a in self.list_auxiliary_states()]
+        return arg_out, out_types, aux_out
 
     # -- serialization (ref: nnvm JSON; legacy_json_util.cc) ------------
     def tojson(self):
