@@ -48,6 +48,8 @@ def _embedding_type(attrs, ins):
 
 
 def _label_free_loss(n_out=1):
+    # a local closure is fine here: OpDef pickles by registry name
+    # (OpDef.__reduce__), so installed rules never serialize
     def rule(attrs, ins):
         data = ins[0]
         full = [data] + [i if i is not None else data for i in ins[1:]]

@@ -62,6 +62,17 @@ class OpDef(object):
         self.var_outputs = var_outputs           # callable(attrs)->list[str] or None
         self.description = description
 
+    # -- pickling -------------------------------------------------------
+    def __reduce__(self):
+        """Pickle by registry name: kernels capture local closures (the
+        register_unary/binary helpers, dtype rules) that cannot serialize,
+        and the live registry object is the authority anyway. Unpickling in
+        another process resolves through ``get`` after import-time
+        registration — exactly how the reference's creator handles travel
+        across ps-lite (by name, ref: python/mxnet/kvstore.py:226 pickling
+        only picklable optimizer state)."""
+        return (get, (self.name,))
+
     # -- arity ----------------------------------------------------------
     def list_inputs(self, attrs):
         if self.var_inputs_attr is not None:

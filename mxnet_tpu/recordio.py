@@ -47,17 +47,18 @@ def _load_native():
         return None
     lib = ctypes.CDLL(so)
     if not hasattr(lib, "mxtpu_img_decode_batch"):
-        # stale prebuilt .so from before the image-decode engine existed:
-        # rebuild once, then reload; give up (Pillow fallback) on failure
-        try:
-            subprocess.run(["make", "-C", os.path.join(root, "src"), "-B"],
-                           check=True, capture_output=True)
-            lib = ctypes.CDLL(so)
-        except Exception:
-            pass
-        if not hasattr(lib, "mxtpu_img_decode_batch"):
-            _NATIVE = False
-            return None
+        # Stale prebuilt .so from before the image-decode engine existed.
+        # Do NOT relink in place: the library is already dlopen'ed, a second
+        # CDLL would return the cached stale handle (dlopen dedupes by inode)
+        # and overwriting a mapped .so risks SIGBUS. Fall back to Pillow and
+        # tell the user to rebuild before the next run.
+        import warnings
+        warnings.warn(
+            "%s is stale (missing mxtpu_img_decode_batch); falling back to "
+            "the Pillow pipeline. Rebuild with `make -C %s -B` and restart."
+            % (so, os.path.join(root, "src")))
+        _NATIVE = False
+        return None
     lib.mxtpu_rio_open.restype = ctypes.c_void_p
     lib.mxtpu_rio_open.argtypes = [ctypes.c_char_p]
     lib.mxtpu_rio_next.restype = ctypes.POINTER(ctypes.c_char)

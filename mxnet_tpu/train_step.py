@@ -114,9 +114,6 @@ class TrainStep(object):
             self._run = self._wrap_remat(self._run)
         self._jit = {}  # keyed by batch size (rescale_grad depends on it)
         self._base_key = None  # drawn lazily from the global seeded stream
-        # host-side step clock for RNG folding: state["step"] may be a
-        # multi-host global array that host code cannot read
-        self._host_step = 0
 
     # ------------------------------------------------------------------
     def _wrap_remat(self, run):
@@ -249,6 +246,11 @@ class TrainStep(object):
 
         def step_fn(state, batch, key, lr_base):
             params, aux, opt = state["params"], state["aux"], state["opt"]
+            # fold the state's OWN step counter into the key (traced, so no
+            # host sync): restoring a checkpointed state reproduces the
+            # dropout/SGLD noise stream implied by its step count, and two
+            # states interleaved through one TrainStep never share noise
+            key = jax.random.fold_in(key, state["step"].astype(jnp.uint32))
 
             def f(p):
                 arg_vals = dict(batch)
@@ -300,10 +302,9 @@ class TrainStep(object):
             # noise; per-step keys fold in the step counter
             if self._base_key is None:
                 self._base_key = _random.split()
-            key = jax.random.fold_in(self._base_key, self._host_step)
+            key = self._base_key  # per-step variation folds in state["step"]
         else:
             key = jax.random.key(0)  # static; unused ops ignore it
-        self._host_step += 1
         # scheduler clock advances host-side; lr rides in as a traced scalar
         self._opt.num_update += 1
         if self._opt.lr_scheduler is not None:

@@ -132,9 +132,12 @@ bool ProcessOne(const uint8_t* buf, uint64_t size, const AugSpec& spec,
                 int index, float* out) {
   std::vector<uint8_t> rgb;
   int w = 0, h = 0;
-  int min_needed = spec.resize_short > 0
-                       ? spec.resize_short
-                       : std::max(spec.out_h, spec.out_w);
+  // DCT downscale only when a resize-short follows (that path re-interpolates
+  // so it stays exact). Without resize_short the fixed-size crop must come
+  // from the FULL-resolution image — a DCT-scaled decode would make the crop
+  // window cover up to 8x more of the original, changing augmentation stats
+  // vs the reference's crop-from-full-res semantics.
+  int min_needed = spec.resize_short > 0 ? spec.resize_short : 0;
   if (!DecodeRGB(buf, size, min_needed, &rgb, &w, &h)) return false;
 
   std::vector<uint8_t> tmp;

@@ -1,0 +1,109 @@
+"""Round-4 bug-sweep regressions.
+
+Pins the round-3 advisor/judge findings: optimizer/symbol picklability
+(dist_sync set_optimizer pickles the Optimizer holding the Symbol),
+checkpoint-reproducible fused-step RNG streams, and sharded-assignment
+robustness.
+"""
+import pickle
+
+import jax
+
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.train_step import TrainStep
+
+
+def _all_model_symbols():
+    from mxnet_tpu import models
+    return [models.get_symbol(name, num_classes=10)
+            for name in ["mlp", "lenet", "alexnet", "vgg", "resnet",
+                         "inception-bn"]]
+
+
+def test_optimizer_with_every_model_symbol_pickles():
+    """KVStore.set_optimizer pickles the Optimizer; the Optimizer holds the
+    Symbol for lr_mult resolution (ref: python/mxnet/kvstore.py:226), so
+    every model symbol must survive a pickle round-trip."""
+    for sym in _all_model_symbols():
+        o = opt.SGD(learning_rate=0.1, sym=sym)
+        o2 = pickle.loads(pickle.dumps(o))
+        assert o2.lr == o.lr
+        # the restored symbol must still infer types (rules intact)
+        s2 = o2.sym
+        assert s2 is not None
+        assert s2.list_arguments() == sym.list_arguments()
+
+
+def test_loss_head_symbol_pickles_and_infers():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    net2 = pickle.loads(pickle.dumps(net))
+    _, out_types, _ = net2.infer_type(data=np.float32)
+    assert out_types[0] == np.dtype(np.float32)
+
+
+def _tiny_dropout_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.LinearRegressionOutput(net, name="out")
+
+
+def test_trainstep_rng_follows_state_step():
+    """The dropout stream is a function of state["step"], so replaying from
+    a restored state reproduces the same noise sequence (advisor r3 low:
+    host-side counters diverge from checkpointed step counts)."""
+    mx.random.seed(7)
+    sym = _tiny_dropout_net()
+    ts = TrainStep(sym, label_names=("out_label",),
+                   optimizer=opt.SGD(learning_rate=0.05))
+    state0 = ts.init({"data": (4, 6)}, {"out_label": (4, 4)})
+    batch = {"data": np.ones((4, 6), np.float32),
+             "out_label": np.zeros((4, 4), np.float32)}
+    # advance two steps, remember outputs; checkpoint s1 to host first
+    # (the fused step donates its input state buffers)
+    s1, o1 = ts.step(state0, batch)
+    ckpt = jax.tree_util.tree_map(np.asarray, s1)
+    s2, o2 = ts.step(s1, batch)
+    # replay step 2 from the restored checkpoint: same noise -> same out
+    restored = jax.tree_util.tree_map(jnp.asarray, ckpt)
+    s2b, o2b = ts.step(restored, batch)
+    np.testing.assert_allclose(np.asarray(o2[0]), np.asarray(o2b[0]),
+                               rtol=0, atol=0)
+    # but step 1 vs step 2 differ (noise actually varies by step)
+    assert not np.allclose(np.asarray(o1[0]), np.asarray(o2[0]))
+
+
+def test_batchnorm_onepass_bf16_matches_numpy():
+    """bf16 activations take the fused one-pass E[x^2]-E[x]^2 stats path;
+    numerics must match a float64 reference within bf16 tolerance, including
+    ill-conditioned data with |mean| >> std."""
+    from mxnet_tpu.ops import registry as reg
+    from mxnet_tpu.ops.registry import OpContext
+    rng = np.random.default_rng(0)
+    x64 = 100.0 + 0.5 * rng.normal(size=(8, 4, 5, 5))
+    x = jnp.asarray(x64, jnp.bfloat16)
+    gamma = jnp.ones((4,), jnp.bfloat16)
+    beta = jnp.zeros((4,), jnp.bfloat16)
+    mm = jnp.zeros((4,), jnp.float32)
+    mv = jnp.ones((4,), jnp.float32)
+    op = reg.get("BatchNorm")
+    (y,), (nm, nv) = op.apply(OpContext(is_train=True), {"fix_gamma": "False"},
+                              [x, gamma, beta], [mm, mv])
+    xf = np.asarray(x, np.float64)  # reference stats over the bf16-rounded data
+    m = xf.mean(axis=(0, 2, 3))
+    v = xf.var(axis=(0, 2, 3))
+    yref = (xf - m[None, :, None, None]) / np.sqrt(v[None, :, None, None] + 1e-3)
+    # y is computed in bf16: (x - mean) at |x|~100 carries up to 0.25 abs
+    # quantization (ulp 0.5), ~0.5 after scaling by 1/std=2. The loose bound
+    # still catches the cancellation failure mode (var collapsing to ~0
+    # inflates y by ~1/sqrt(eps) ~ 30x).
+    np.testing.assert_allclose(np.asarray(y, np.float64), yref, atol=0.7)
+    np.testing.assert_allclose(np.asarray(nm), 0.9 * 0 + 0.1 * m, rtol=0.02)
+    np.testing.assert_allclose(np.asarray(nv), 0.9 * 1 + 0.1 * v, rtol=0.05)
