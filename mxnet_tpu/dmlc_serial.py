@@ -1,0 +1,143 @@
+"""Reference-compatible binary NDArray serialization (the ``.params`` format).
+
+Byte layout reproduced from the reference implementation
+(ref: src/ndarray/ndarray.cc:605-693, include/mxnet/ndarray.h:360-373,
+include/mxnet/base.h:163-176; dmlc::Stream vector/string framing):
+
+    uint64  magic = 0x112 (kMXAPINDArrayListMagic)
+    uint64  reserved = 0
+    uint64  ndarray count
+    per NDArray:
+        uint32  ndim, uint32 dims[ndim]      (mshadow TShape::Save)
+        int32   dev_type, int32 dev_id       (Context::Save)
+        int32   type_flag                    (mshadow type flags)
+        raw little-endian tensor bytes
+    uint64  name count (0 when saved as a bare list)
+    per name: uint64 length, utf-8 bytes
+
+mshadow type flags: 0=float32 1=float64 2=float16 3=uint8 4=int32. The era
+has no bfloat16/int64; extension flags ≥100 cover them for round-tripping
+repo checkpoints while staying out of the reference's flag space.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+MAGIC = 0x112
+
+_FLAG2DTYPE = {
+    0: np.dtype(np.float32),
+    1: np.dtype(np.float64),
+    2: np.dtype(np.float16),
+    3: np.dtype(np.uint8),
+    4: np.dtype(np.int32),
+    # extension flags (not emitted by the reference)
+    100: np.dtype("bfloat16"),
+    101: np.dtype(np.int64),
+    102: np.dtype(np.uint64),
+    103: np.dtype(np.int8),
+    104: np.dtype(np.bool_),
+}
+_DTYPE2FLAG = {v: k for k, v in _FLAG2DTYPE.items()}
+
+
+def _dtype_flag(dt):
+    dt = np.dtype(dt)
+    if dt in _DTYPE2FLAG:
+        return _DTYPE2FLAG[dt]
+    raise MXNetError("save: dtype %s has no .params type flag" % dt)
+
+
+def dump(fo, arrays, names):
+    """Stream numpy arrays (+ optional names) to a file object in the
+    reference .params layout — one write per tensor, no full-blob copy."""
+    fo.write(struct.pack("<QQ", MAGIC, 0))
+    fo.write(struct.pack("<Q", len(arrays)))
+    for arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        flag = _dtype_flag(arr.dtype)
+        fo.write(struct.pack("<I", arr.ndim))
+        fo.write(struct.pack("<%dI" % arr.ndim, *arr.shape))
+        fo.write(struct.pack("<ii", 1, 0))          # Context: kCPU, dev 0
+        fo.write(struct.pack("<i", flag))
+        if arr.dtype == np.dtype("bfloat16"):
+            arr = arr.view(np.uint16)
+        fo.write(arr.data if arr.ndim else arr.tobytes())
+    fo.write(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        fo.write(struct.pack("<Q", len(b)))
+        fo.write(b)
+
+
+def dumps(arrays, names):
+    """Serialize to bytes (testing convenience; save() streams via dump)."""
+    import io
+    buf = io.BytesIO()
+    dump(buf, arrays, names)
+    return buf.getvalue()
+
+
+class _Reader(object):
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n):
+        if self.pos + n > len(self.buf):
+            raise MXNetError("Invalid NDArray file format (truncated)")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def u64(self):
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def u32(self):
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.take(4))[0]
+
+
+def loads(buf):
+    """Parse reference .params bytes -> (list of np arrays, list of names)."""
+    r = _Reader(buf)
+    if r.u64() != MAGIC:
+        raise MXNetError("Invalid NDArray file format (bad magic)")
+    r.u64()                                          # reserved
+    arrays = []
+    for _ in range(r.u64()):
+        ndim = r.u32()
+        if ndim == 0:                                # is_none() NDArray
+            arrays.append(np.zeros((), np.float32))
+            continue
+        shape = struct.unpack("<%dI" % ndim, r.take(4 * ndim))
+        r.i32(); r.i32()                             # Context (ignored: host load)
+        flag = r.i32()
+        if flag not in _FLAG2DTYPE:
+            raise MXNetError("load: unknown type flag %d" % flag)
+        dt = _FLAG2DTYPE[flag]
+        n = int(np.prod(shape)) if shape else 1
+        raw = r.take(n * dt.itemsize)
+        if dt == np.dtype("bfloat16"):
+            arr = np.frombuffer(raw, np.uint16).view(dt).reshape(shape)
+        else:
+            arr = np.frombuffer(raw, dt).reshape(shape)
+        arrays.append(arr.copy())
+    names = []
+    nname = r.u64()
+    if nname not in (0, len(arrays)):
+        raise MXNetError("Invalid NDArray file format (name count)")
+    for _ in range(nname):
+        names.append(r.take(r.u64()).decode("utf-8"))
+    return arrays, names
+
+
+def sniff(buf):
+    """True when buf starts with the reference list magic."""
+    return len(buf) >= 8 and struct.unpack("<Q", buf[:8])[0] == MAGIC

@@ -452,22 +452,39 @@ class Symbol(object):
                    for a in self.list_auxiliary_states()]
         return arg_out, out_types, aux_out
 
-    # -- serialization (ref: nnvm JSON; legacy_json_util.cc) ------------
+    # -- serialization (ref: nnvm JSON save; legacy_json_util.cc) -------
     def tojson(self):
+        """Emit reference NNVM graph JSON: 3-element ``[id, idx, version]``
+        inputs, ``arg_nodes``/``node_row_ptr``/``heads``, op params and user
+        attrs merged into one stringified ``attrs`` dict, and a top-level
+        ``attrs.mxnet_version`` (ref: nnvm SaveJSON pass;
+        src/nnvm/legacy_json_util.cc format contract)."""
         nodes = _topo(self._out_nodes())
         nid = {id(n): i for i, n in enumerate(nodes)}
-        jnodes = []
-        for n in nodes:
-            jnodes.append({
+        jnodes, arg_nodes, row_ptr = [], [], [0]
+        for i, n in enumerate(nodes):
+            jn = {
                 "op": "null" if n.is_variable else n.op.name,
                 "name": n.name,
-                "attrs": {k: str(v) for k, v in n.attrs.items()},
-                "user_attrs": {k: str(v) for k, v in n._user_attr.items()},
-                "inputs": [[nid[id(inp)], idx] for inp, idx in n.inputs],
-            })
-        heads = [[nid[id(n)], idx] for n, idx in self._outputs]
-        return json.dumps({"nodes": jnodes, "heads": heads,
-                           "mxnet_tpu_version": 1}, indent=2)
+                "inputs": [[nid[id(inp)], idx, 0] for inp, idx in n.inputs],
+            }
+            merged = {k: str(v) for k, v in n.attrs.items()}
+            # hidden keys are stored wrapped in the reference
+            # (c_api_symbolic.cc kReplacedHiddenKeys); a plain "ctx_group"
+            # under version 905 would hit op attr parsers on reference load
+            merged.update({("__%s__" % k if k in _HIDDEN_KEYS else k): str(v)
+                           for k, v in n._user_attr.items()})
+            if merged:
+                jn["attrs"] = merged
+            jnodes.append(jn)
+            if n.is_variable:
+                arg_nodes.append(i)
+            row_ptr.append(row_ptr[-1] + n.num_outputs())
+        heads = [[nid[id(n)], idx, 0] for n, idx in self._outputs]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": row_ptr, "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 905]}},
+                          indent=2)
 
     def save(self, fname):
         with open(fname, "w") as f:
@@ -530,18 +547,94 @@ def load(fname):
         return load_json(f.read())
 
 
+# Attr keys the reference stores double-underscore-wrapped on migration
+# (ref: src/c_api/c_api_symbolic.cc:20 kHiddenKeys,
+# src/nnvm/legacy_json_util.cc UpgradeJSON_FixParsing).
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage")
+
+
+def _split_attrs(raw):
+    """Split a loaded NNVM node attr dict into (op attrs, user attrs),
+    migrating hidden keys to the form the repo's consumers read
+    (``__lr_mult__`` etc.; ``ctx_group`` stays plain for placement)."""
+    op_attrs, user = {}, {}
+    for k, v in raw.items():
+        if k.startswith("__") and k.endswith("__"):
+            inner = k[2:-2]
+            user["ctx_group" if inner == "ctx_group" else k] = v
+        elif k in _HIDDEN_KEYS:
+            user["ctx_group" if k == "ctx_group" else "__%s__" % k] = v
+        else:
+            op_attrs[k] = v
+    return op_attrs, user
+
+
 def load_json(json_str):
+    """Parse symbol JSON. Accepts (a) current NNVM graph JSON (3-element
+    inputs, merged ``attrs``), (b) pre-0.9 legacy JSON (``param`` dicts,
+    2-element inputs, missing aux variables, suffix-style hidden keys —
+    upgrade rules from src/nnvm/legacy_json_util.cc), and (c) this repo's
+    pre-round-4 2-tuple format."""
     data = json.loads(json_str)
+    if "mxnet_tpu_version" in data:            # repo legacy format
+        nodes = []
+        for jn in data["nodes"]:
+            if jn["op"] == "null":
+                node = _Node(None, jn["name"],
+                             user_attr=jn.get("user_attrs", {}))
+            else:
+                node = _Node(_reg.get(jn["op"]), jn["name"],
+                             jn.get("attrs", {}),
+                             user_attr=jn.get("user_attrs", {}))
+            node.inputs = [(nodes[i], idx) for i, idx in jn["inputs"]]
+            nodes.append(node)
+        return Symbol([(nodes[i], idx) for i, idx in data["heads"]])
+
     nodes = []
     for jn in data["nodes"]:
-        if jn["op"] == "null":
-            node = _Node(None, jn["name"], user_attr=jn.get("user_attrs", {}))
+        raw = dict(jn.get("attrs") or jn.get("attr") or jn.get("param") or {})
+        if "attrs" not in jn and "attr" in jn and "param" in jn:
+            raw.update(jn["param"])            # 0.8 stores both
+        op_attrs, user = _split_attrs(raw)
+        opname = jn["op"]
+        if opname == "null":
+            # a variable has no op params: every remaining attr is a user
+            # attr (keeps e.g. attr={'stage': '2'} across round-trips)
+            user.update(op_attrs)
+            node = _Node(None, jn["name"], user_attr=user)
         else:
-            node = _Node(_reg.get(jn["op"]), jn["name"], jn.get("attrs", {}),
-                         user_attr=jn.get("user_attrs", {}))
-        node.inputs = [(nodes[i], idx) for i, idx in jn["inputs"]]
+            if not _reg.exists(opname):
+                raise MXNetError("load_json: unknown operator %r" % opname)
+            node = _Node(_reg.get(opname), jn["name"], op_attrs,
+                         user_attr=user)
+        node.inputs = [(nodes[e[0]], e[1]) for e in jn["inputs"]]
         nodes.append(node)
-    return Symbol([(nodes[i], idx) for i, idx in data["heads"]])
+
+    # legacy upgrades (ref: legacy_json_util.cc) — suffix hidden keys
+    # ("weight_lr_mult" -> __lr_mult__ on the weight input variable) and
+    # aux variables absent from pre-0.9 graphs.
+    for node in nodes:
+        if node.is_variable:
+            continue
+        arg_names = node.op.list_inputs(node.attrs)
+        for k in list(node.attrs):
+            for key in _HIDDEN_KEYS:
+                if k.endswith("_" + key):
+                    arg = k[:-(len(key) + 1)]
+                    if arg in arg_names:
+                        i = arg_names.index(arg)
+                        if i < len(node.inputs) and node.inputs[i][0].is_variable:
+                            dst = ("ctx_group" if key == "ctx_group"
+                                   else "__%s__" % key)
+                            node.inputs[i][0]._user_attr[dst] = node.attrs.pop(k)
+                    break
+        if len(node.inputs) < len(arg_names):
+            for aname in arg_names[len(node.inputs):]:
+                var = _Node(None, "%s_%s" % (node.name, aname),
+                            user_attr=dict(node._user_attr))
+                node.inputs.append((var, 0))
+    return Symbol([(nodes[e[0]], e[1]) for e in data["heads"]])
 
 
 # ---------------------------------------------------------------------------

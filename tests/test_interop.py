@@ -1,0 +1,186 @@
+"""Reference-format interop: NNVM symbol JSON + dmlc binary .params.
+
+Ref contracts: src/nnvm/legacy_json_util.cc (JSON upgrade),
+src/ndarray/ndarray.cc:605-693 + include/mxnet/ndarray.h:360-373 (.params).
+"""
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import dmlc_serial
+
+REF_JSON = "/root/reference/tests/python/unittest/save_000800.json"
+
+
+# ---------------------------------------------------------------------------
+# symbol JSON
+# ---------------------------------------------------------------------------
+def test_load_reference_legacy_json():
+    sym = mx.symbol.load(REF_JSON)
+    args = sym.list_arguments()
+    assert args[0] == "data" and "fc1_weight" in args
+    # suffix hidden-key migration: "weight_lr_mult" lands on fc1_weight
+    ad = sym.attr_dict()
+    assert ad["fc1_weight"]["__lr_mult__"] == "1.2"
+    assert ad["fc1_weight"]["__wd_mult__"] == "0.3"
+    assert ad["fc1_weight"]["ctx_group"] == "stage1"
+    # node-level hidden keys migrate too
+    assert ad["fc2_weight"]["__lr_mult__"] == "0.01"
+
+
+def test_legacy_json_binds_and_runs():
+    sym = mx.symbol.load(REF_JSON)
+    ex = sym.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape[0] == 4
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_nnvm_json_shape():
+    data = mx.sym.Variable("data", lr_mult=2.0)
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="sm")
+    d = json.loads(net.tojson())
+    assert set(d) == {"nodes", "arg_nodes", "node_row_ptr", "heads", "attrs"}
+    assert d["attrs"]["mxnet_version"] == ["int", 905]
+    for n in d["nodes"]:
+        for e in n["inputs"]:
+            assert len(e) == 3 and e[2] == 0
+    null_ids = [i for i, n in enumerate(d["nodes"]) if n["op"] == "null"]
+    assert d["arg_nodes"] == null_ids
+    assert d["node_row_ptr"][0] == 0
+    assert d["node_row_ptr"][-1] >= len(d["nodes"])
+
+
+def test_nnvm_json_roundtrip_semantics():
+    data = mx.sym.Variable("data", lr_mult=0.5, wd_mult=2.0)
+    w = mx.sym.Variable("w", shape=(8, 10))
+    net = mx.sym.FullyConnected(data=data, weight=w, num_hidden=8, name="fc")
+    net = mx.sym.Activation(net, act_type="relu", name="r")
+    back = mx.sym.load_json(net.tojson())
+    assert back.list_arguments() == net.list_arguments()
+    assert back.attr_dict()["data"]["__lr_mult__"] == "0.5"
+    s1, _, _ = net.infer_shape(data=(4, 10))
+    s2, _, _ = back.infer_shape(data=(4, 10))
+    assert s1 == s2
+    # second-generation JSON identical (stable emission)
+    assert back.tojson() == mx.sym.load_json(back.tojson()).tojson()
+
+
+def test_repo_legacy_2tuple_format_still_loads():
+    js = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "x", "attrs": {}, "user_attrs": {},
+             "inputs": []},
+            {"op": "relu", "name": "r", "attrs": {}, "user_attrs": {},
+             "inputs": [[0, 0]]},
+        ],
+        "heads": [[1, 0]],
+        "mxnet_tpu_version": 1,
+    })
+    sym = mx.sym.load_json(js)
+    assert sym.list_arguments() == ["x"]
+
+
+def test_unknown_op_raises():
+    js = json.dumps({"nodes": [{"op": "NoSuchOp9", "name": "n", "inputs": []}],
+                     "arg_nodes": [], "heads": [[0, 0, 0]],
+                     "attrs": {"mxnet_version": ["int", 905]}})
+    with pytest.raises(mx.base.MXNetError):
+        mx.sym.load_json(js)
+
+
+# ---------------------------------------------------------------------------
+# binary .params
+# ---------------------------------------------------------------------------
+def test_params_header_layout(tmp_path):
+    f = str(tmp_path / "x.params")
+    mx.nd.save(f, {"w": mx.nd.array(np.arange(6, np.float32).reshape(2, 3)
+                                    if False else
+                                    np.arange(6, dtype=np.float32).reshape(2, 3))})
+    buf = open(f, "rb").read()
+    magic, reserved, count = struct.unpack("<QQQ", buf[:24])
+    assert magic == 0x112 and reserved == 0 and count == 1
+    ndim = struct.unpack("<I", buf[24:28])[0]
+    assert ndim == 2
+    dims = struct.unpack("<2I", buf[28:36])
+    assert dims == (2, 3)
+    dev_type, dev_id, type_flag = struct.unpack("<iii", buf[36:48])
+    assert dev_type == 1 and type_flag == 0        # kCPU, kFloat32
+    vals = np.frombuffer(buf[48:48 + 24], np.float32)
+    np.testing.assert_array_equal(vals, np.arange(6, dtype=np.float32))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "uint8", "int32",
+                                   "bfloat16"])
+def test_params_roundtrip_dtypes(tmp_path, dtype):
+    f = str(tmp_path / "d.params")
+    dt = np.dtype(dtype)
+    a = (np.random.rand(3, 5) * 10).astype(dt)
+    mx.nd.save(f, {"a": mx.nd.array(a, dtype=dt)})
+    b = mx.nd.load(f)["a"].asnumpy()
+    assert b.dtype == dt
+    np.testing.assert_array_equal(np.asarray(a, np.float64),
+                                  np.asarray(b, np.float64))
+
+
+@pytest.mark.parametrize("dtype", ["float64", "int64"])
+def test_params_wire_dtypes_beyond_jax_default(dtype):
+    """f64/i64 survive the wire format itself (JAX x64-off narrows NDArrays,
+    so these are exercised at the serializer layer)."""
+    a = (np.random.rand(4, 3) * 9).astype(dtype)
+    arrs, names = dmlc_serial.loads(dmlc_serial.dumps([a], ["a"]))
+    assert names == ["a"] and arrs[0].dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(arrs[0], a)
+
+
+def test_params_list_roundtrip(tmp_path):
+    f = str(tmp_path / "l.params")
+    data = [mx.nd.ones((2, 2)), mx.nd.zeros((3,))]
+    mx.nd.save(f, data)
+    back = mx.nd.load(f)
+    assert isinstance(back, list) and len(back) == 2
+    np.testing.assert_array_equal(back[0].asnumpy(), np.ones((2, 2), np.float32))
+
+
+def test_params_bit_exact_double_roundtrip(tmp_path):
+    f1, f2 = str(tmp_path / "a.params"), str(tmp_path / "b.params")
+    data = {"x": mx.nd.array(np.random.randn(4, 7).astype(np.float32)),
+            "y": mx.nd.array(np.random.randn(9).astype(np.float32))}
+    mx.nd.save(f1, data)
+    mx.nd.save(f2, mx.nd.load(f1))
+    assert open(f1, "rb").read() == open(f2, "rb").read()
+
+
+def test_legacy_npz_still_loads(tmp_path):
+    f = str(tmp_path / "legacy.npz")
+    np.savez(open(f, "wb"), w=np.ones((2, 2), np.float32))
+    back = mx.nd.load(f)
+    np.testing.assert_array_equal(back["w"].asnumpy(), np.ones((2, 2)))
+
+
+def test_module_checkpoint_reference_format(tmp_path):
+    """Module.save_checkpoint emits a reference-openable pair."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 6))], label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 3)
+    buf = open(prefix + "-0003.params", "rb").read()
+    assert dmlc_serial.sniff(buf)
+    arrs, names = dmlc_serial.loads(buf)
+    assert any(n.startswith("arg:") for n in names)
+    sym = mx.symbol.load(prefix + "-symbol.json")
+    assert "fc_weight" in sym.list_arguments()
+    sym2, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    np.testing.assert_array_equal(
+        args["fc_weight"].asnumpy(),
+        mod.get_params()[0]["fc_weight"].asnumpy())
