@@ -65,17 +65,25 @@ def main():
     cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     baseline = 181.53  # P100, ResNet-50 train b32 (docs/how_to/perf.md:183-190)
 
+    # measured r4: remat=conv loses ~17% on v5e (recompute re-reads conv
+    # outputs; chip is HBM-bound) — remat stays a memory knob, not a default
+    remat = os.environ.get("BENCH_REMAT", "off")  # conv|full|off
+    # measured r4: NHWC+Pallas conv+BN-stats fusion is 2x SLOWER than
+    # letting XLA fuse (docs/perf.md r4 section) — NCHW/XLA stays default
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    dshape = ((batch, image, image, 3) if layout == "NHWC"
+              else (batch, 3, image, image))
     sym = models.resnet(num_classes=1000, num_layers=depth,
-                        image_shape="3,%d,%d" % (image, image))
+                        image_shape="3,%d,%d" % (image, image),
+                        layout=layout)
     step = TrainStep(sym, optimizer="sgd", learning_rate=0.1, momentum=0.9,
                      wd=1e-4,
+                     remat={"conv": "conv", "full": True}.get(remat, False),
                      compute_dtype=None if cdtype == "float32" else cdtype)
-    state = step.init({"data": (batch, 3, image, image)},
-                      {"softmax_label": (batch,)})
+    state = step.init({"data": dshape}, {"softmax_label": (batch,)})
 
     rng = np.random.default_rng(0)
-    data = {"data": jnp.asarray(rng.normal(size=(batch, 3, image, image)),
-                                np.float32),
+    data = {"data": jnp.asarray(rng.normal(size=dshape), np.float32),
             "softmax_label": jnp.asarray(rng.integers(0, 1000, batch),
                                          np.float32)}
 
@@ -97,8 +105,7 @@ def main():
             if attempt == 3:
                 raise
             time.sleep(3)
-            state = step.init({"data": (batch, 3, image, image)},
-                              {"softmax_label": (batch,)})
+            state = step.init({"data": dshape}, {"softmax_label": (batch,)})
 
     best_ips = 0.0
     for _ in range(rounds):

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Long-context transformer LM with sequence parallelism — the flagship
+example superseding the reference's model-parallel LSTM
+(ref: example/model-parallel-lstm/lstm.py:48-112, SURVEY.md §5).
+
+Trains a causal LM on a synthetic copy task (predict the token seen k steps
+ago — solvable only through attention) with:
+  --seq-parallel ''        single chip, blockwise (flash-style) attention
+  --seq-parallel ring      K/V shards rotate over the mesh 'seq' axis (ICI)
+  --seq-parallel ulysses   all-to-all head sharding over 'seq'
+  --dp N --sp M            dp x sp mesh factorization
+  --check                  assert the parallel run matches single-device
+
+On the dev box an 8-device virtual CPU mesh stands in for the pod slice:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python train_transformer.py --sp 4 --dp 2 --seq-parallel ring --check
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_copy_task(rng, n, batch, seq_len, vocab, lag):
+    """Token stream where label[t] = data[t-lag] (0 for t<lag)."""
+    for _ in range(n):
+        x = rng.integers(1, vocab, (batch, seq_len))
+        y = np.zeros_like(x)
+        y[:, lag:] = x[:, :-lag]
+        yield x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-parallel", default="",
+                    choices=["", "ring", "ulysses"])
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--sp", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--embed", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--lag", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--check", action="store_true",
+                    help="also run single-device and compare params")
+    args = ap.parse_args()
+
+    import jax
+    # the axon sitecustomize pins JAX_PLATFORMS at interpreter start; honor
+    # an explicit cpu request (the virtual-mesh dev recipe) in-process
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu import models
+    from mxnet_tpu.train_step import TrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh, MeshScope
+
+    def train(mode, mesh, optimizer="adam"):
+        sym = models.transformer(
+            vocab_size=args.vocab, embed=args.embed, num_heads=args.heads,
+            num_layers=args.layers, seq_len=args.seq_len,
+            seq_parallel=mode)
+        scope = MeshScope(mesh) if mesh is not None else None
+        if scope:
+            scope.__enter__()
+        try:
+            step = TrainStep(sym, optimizer=optimizer, learning_rate=args.lr,
+                             mesh=mesh)
+            st = step.init({"data": (args.batch, args.seq_len)},
+                           {"softmax_label": (args.batch, args.seq_len)},
+                           seed=0)
+            rng = np.random.default_rng(0)
+            losses = []
+            for x, y in make_copy_task(rng, args.steps, args.batch,
+                                       args.seq_len, args.vocab, args.lag):
+                batch = {"data": x, "softmax_label": y}
+                if mesh is not None:
+                    batch = step.shard_batch(batch)
+                st, outs = step.step(st, batch)
+                probs = np.asarray(outs[0], np.float32)
+                yy = y.reshape(-1).astype(int)
+                losses.append(float(-np.log(
+                    probs[np.arange(len(yy)), yy] + 1e-9).mean()))
+            return st, losses
+        finally:
+            if scope:
+                scope.__exit__(None, None, None)
+
+    mesh = None
+    if args.seq_parallel:
+        mesh = make_mesh({"data": args.dp, "seq": args.sp})
+        print("mesh:", dict(zip(mesh.axis_names, mesh.devices.shape)))
+    st, losses = train(args.seq_parallel, mesh)
+    print("loss: first %.3f -> last %.3f" % (losses[0], losses[-1]))
+    assert losses[-1] < losses[0] * 0.5, "copy task failed to learn"
+
+    if args.check and args.seq_parallel:
+        st_ref, losses_ref = train("", None)
+        # long-horizon float chaos makes exact param comparison meaningless
+        # (docs/perf.md r4 f64 analysis); the checks that matter: the same
+        # task is learned to the same loss, and ONE step agrees tightly.
+        print("final loss parallel %.3f vs single %.3f"
+              % (losses[-1], losses_ref[-1]))
+        assert abs(losses[-1] - losses_ref[-1]) < 0.25, \
+            "parallel final loss diverged from single-device"
+        # plain SGD for the one-step check: adam's sqrt(v) normalization
+        # turns roundoff-level gradient noise into O(lr) update noise
+        args_steps, args.steps = args.steps, 1
+        st1p, _ = train(args.seq_parallel, mesh, optimizer="sgd")
+        st1s, _ = train("", None, optimizer="sgd")
+        args.steps = args_steps
+        worst = max(
+            float(np.abs(np.asarray(st1p["params"][k], np.float32)
+                         - np.asarray(st1s["params"][k], np.float32)).max())
+            for k in st1s["params"])
+        print("one-step max param divergence: %.2e" % worst)
+        assert worst < 1e-4, "one-step parallel numerics diverged"
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

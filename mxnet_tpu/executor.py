@@ -17,6 +17,8 @@ XLA invocation, mirroring the reference's engine overlap for free.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -44,8 +46,39 @@ def _build_graph_runner(symbol, placement=None):
         node_groups = {id(n): node_group(n) for n in nodes}
         var_groups = param_groups(nodes)
 
+    # Conv(1x1 NHWC)+BN fusion pass (the Pallas conv+stats epilogue — see
+    # ops/pallas_fused.py). The TPU analog of the reference's cuDNN fused
+    # epilogues; peephole over the DAG like nnvm's DetectInplaceAddTo
+    # (ref: src/executor/inplace_addto_detect_pass.cc pattern).
+    # OPT-IN: measured 2x slower than letting XLA fuse on v5e
+    # (docs/perf.md r4) — "1" enables on TPU, "interpret" for CPU tests.
+    fuse_mode = os.environ.get("MXTPU_FUSE_CONV_BN", "0")
+    fused_convs = {}        # id(conv node) -> conv node
+    bn_stats_src = {}       # id(bn node) -> id(conv node)
+    if fuse_mode != "0" and placement is None:
+        from .ops import pallas_fused as _pf
+        for node in nodes:
+            if node.is_variable or node.op.name != "BatchNorm":
+                continue
+            if not node.inputs or not _pf.bn_fusable(node.attrs):
+                continue
+            src, src_idx = node.inputs[0]
+            if (src_idx == 0 and not src.is_variable
+                    and src.op.name == "Convolution"
+                    and _pf.conv1x1_fusable(src.attrs)):
+                fused_convs[id(src)] = src
+                bn_stats_src[id(node)] = id(src)
+
     def run(arg_vals, aux_vals, key, is_train):
+        if fused_convs and is_train:
+            from .ops import pallas_fused as _pf
+            interp = (fuse_mode == "interpret"
+                      or jax.default_backend() != "tpu")
+            use_fusion = fuse_mode == "interpret" or not interp
+        else:
+            use_fusion = False
         env = {}
+        stats_env = {}
         aux_updates = {}
         for k, node in enumerate(nodes):
             if node.is_variable:
@@ -65,13 +98,24 @@ def _build_graph_runner(symbol, placement=None):
             rng = None
             if node.op.needs_rng and key is not None:
                 rng = jax.random.fold_in(key, k)
-            op_ctx = OpContext(is_train=is_train, rng=rng)
+            fused_stats = (stats_env.get(bn_stats_src.get(id(node)))
+                           if use_fusion else None)
+            op_ctx = OpContext(is_train=is_train, rng=rng,
+                               fused_stats=fused_stats)
             # named_scope threads op names into XLA metadata so profiler
             # traces show MXNet op names, not anonymous fusions (ref:
             # PROFILER_MESSAGE threading names through every engine push,
             # include/mxnet/base.h:79-83)
-            with jax.named_scope("%s:%s" % (node.op.name, node.name)):
-                outs, aux_up = node.op.apply(op_ctx, node.attrs, ins, aux_in)
+            if use_fusion and id(node) in fused_convs:
+                with jax.named_scope("ConvBNStats:%s" % node.name):
+                    y, stats = _pf.apply_conv1x1_stats(ins[0], ins[1],
+                                                       interpret=interp)
+                stats_env[id(node)] = stats
+                outs, aux_up = (y,), None
+            else:
+                with jax.named_scope("%s:%s" % (node.op.name, node.name)):
+                    outs, aux_up = node.op.apply(op_ctx, node.attrs, ins,
+                                                 aux_in)
             g = node_groups.get(id(node))
             if g is not None:
                 outs = [placement.constrain(g, o) for o in outs]
@@ -387,11 +431,33 @@ class Executor(object):
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Return a new executor bound to new input shapes, sharing parameter
         arrays whose shapes are unchanged (ref: executor.py reshape — the
-        bucketing re-bind path; jit caching makes this cheap)."""
+        bucketing re-bind path; jit caching makes this cheap).
+
+        Flag semantics match the reference: without ``partial_shaping`` only
+        the explicitly passed inputs may change shape — a derived (weight/
+        aux) shape change raises; without ``allow_up_sizing`` a resized
+        array may not grow beyond its current element count."""
         new_shapes = {}
         for n in self.arg_names:
             if n in kwargs:
                 new_shapes[n] = tuple(kwargs[n])
+
+        def _resize(name, cur, sh, explicit):
+            if not (explicit or partial_shaping):
+                raise MXNetError(
+                    "reshape: %r changes shape %s -> %s; pass "
+                    "partial_shaping=True to allow reshaping arguments "
+                    "beyond the given inputs" % (name, tuple(cur.shape),
+                                                 tuple(sh)))
+            new_size = int(np.prod(sh)) if sh else 1
+            cur_size = cur.size
+            if new_size > cur_size and not allow_up_sizing:
+                raise MXNetError(
+                    "reshape: %r grows %d -> %d elements; pass "
+                    "allow_up_sizing=True to allocate larger arrays"
+                    % (name, cur_size, new_size))
+            return NDArray(jnp.zeros(sh, cur.data.dtype))
+
         arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**new_shapes)
         args = {}
         grads = {}
@@ -402,14 +468,14 @@ class Executor(object):
                 if n in self.grad_dict:
                     grads[n] = self.grad_dict[n]
             else:
-                args[n] = NDArray(jnp.zeros(sh, cur.data.dtype))
+                args[n] = _resize(n, cur, sh, n in kwargs)
                 if n in self.grad_dict:
                     grads[n] = NDArray(jnp.zeros(sh, cur.data.dtype))
         aux = {}
         for n, sh in zip(self.aux_names, aux_shapes):
             cur = self.aux_dict[n]
             aux[n] = (cur if sh is None or tuple(cur.shape) == tuple(sh)
-                      else NDArray(jnp.zeros(sh, cur.data.dtype)))
+                      else _resize(n, cur, sh, False))
         return Executor(self._symbol, self._ctx, args, grads or None,
                         self._grad_req, aux,
                         group2ctx=(self._placement if self._placement

@@ -1,0 +1,27 @@
+"""Process initialization: crash backtraces + profiler autostart.
+
+Python analog of the reference's startup hooks (ref: src/initialize.cc:1-61
+— SIGSEGV backtrace handler and MXNET_PROFILER_AUTOSTART). Native crashes
+in the JAX/XLA substrate get a Python-side traceback dump via faulthandler;
+set MXNET_USE_SIGNAL_HANDLER=0 to opt out (embedding hosts that install
+their own handlers, e.g. language bindings over the C API).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def install():
+    if os.environ.get("MXNET_USE_SIGNAL_HANDLER", "1") == "0":
+        return
+    try:
+        import faulthandler
+        # stderr may be closed/replaced in embedded interpreters
+        if getattr(sys.stderr, "fileno", None) is not None:
+            faulthandler.enable(file=sys.stderr, all_threads=True)
+    except Exception:
+        pass
+
+
+install()

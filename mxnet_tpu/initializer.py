@@ -274,3 +274,52 @@ class Mixed(object):
                 init(name, arr)
                 return
         raise MXNetError("Mixed: no matching pattern for %r" % str(name))
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize a FusedRNNCell's packed parameter vector by unpacking it
+    into per-cell i2h/h2h weights and biases, applying ``init`` to each, and
+    re-packing — with the LSTM forget-gate bias slice set to ``forget_bias``
+    (ref: python/mxnet/initializer.py class FusedRNN)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INIT_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+        from . import ndarray as nd
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights({"parameters": nd.array(arr)})
+        h = self._num_hidden
+        for name, sub in args.items():
+            sub_desc = InitDesc(name, global_init=desc.global_init)
+            if self._init is None:
+                if desc.global_init is None:
+                    raise MXNetError(
+                        "FusedRNN: no init given and no global initializer")
+                desc.global_init(sub_desc, sub)
+            else:
+                self._init(sub_desc, sub)
+            if self._mode == "lstm" and name.endswith("_bias"):
+                # gate order [i, f, c, o] (ops/rnn_op.py _GATES): the forget
+                # slice gets the bias that keeps early memory open
+                v = np.array(sub.asnumpy())
+                v[h:2 * h] = self._forget_bias
+                sub[:] = v
+        arr[:] = cell.pack_weights(args)["parameters"].asnumpy()

@@ -125,9 +125,100 @@ class KVStore(object):
 
     def num_dead_node(self, node_id, timeout_sec=60):
         """ref: kvstore_dist.h:159-168 — dead-node count surfaced to user
-        scripts; on the jax.distributed control plane failures surface as
-        exceptions, so a healthy store reports 0."""
+        scripts. Single-process stores have no peers, so report 0; the
+        dist_sync store overrides this with a coordination-service
+        heartbeat scan."""
         return 0
+
+
+class _Heartbeat(object):
+    """Worker liveness over the jax.distributed coordination service —
+    the ps-lite heartbeat analog (ref: ps::Postoffice::GetDeadNodes used at
+    kvstore_dist.h:159-168). Each worker's daemon thread stamps
+    ``mxtpu_hb/<rank>`` every ``interval`` seconds; peers count ranks whose
+    stamp is stale. Publishing piggybacks the already-running rendezvous
+    server: no extra sockets, no extra ports."""
+
+    KEY = "mxtpu_hb/%d"
+
+    def __init__(self, rank, interval=2.0):
+        self.rank = rank
+        self.interval = interval
+        self._stop = None
+        client = self._client()
+        if client is None:
+            return
+        import threading
+        self._stop = threading.Event()
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                self._publish(client)
+        self._publish(client)
+        t = threading.Thread(target=beat, name="mxtpu-heartbeat", daemon=True)
+        t.start()
+
+    @staticmethod
+    def _client():
+        try:
+            from jax._src import distributed
+            return distributed.global_state.client
+        except Exception:
+            return None
+
+    def _publish(self, client):
+        import time
+        key = self.KEY % self.rank
+        stamp = repr(time.time())
+        try:
+            client.key_value_set(key, stamp, allow_overwrite=True)
+        except TypeError:            # older jaxlib: no overwrite kwarg
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                pass
+            try:
+                client.key_value_set(key, stamp)
+            except Exception:
+                pass
+        except Exception:
+            pass
+
+    def dead_nodes(self, size, timeout_sec):
+        import time
+        client = self._client()
+        if client is None or size <= 1:
+            return 0
+        now = time.time()
+        dead = 0
+        for r in range(size):
+            if r == self.rank:
+                continue
+            try:
+                v = client.key_value_try_get(self.KEY % r)
+                if now - float(v) > timeout_sec:
+                    dead += 1
+            except Exception:        # never published -> dead or not up yet
+                dead += 1
+        return dead
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+
+
+_HB = None
+
+
+def _shared_heartbeat(rank):
+    """One heartbeat thread per process, stopped at exit — repeated
+    KVStore creation must not accumulate beat threads."""
+    global _HB
+    if _HB is None:
+        import atexit
+        _HB = _Heartbeat(rank)
+        atexit.register(_HB.stop)
+    return _HB
 
 
 class KVStoreDistSync(KVStore):
@@ -144,6 +235,15 @@ class KVStoreDistSync(KVStore):
         self._rank, self._size = _dist_rank_size()
         self._gmesh = None
         self._sum_fn = None
+        self._heartbeat = (_shared_heartbeat(self._rank)
+                           if self._size > 1 else None)
+
+    def num_dead_node(self, node_id, timeout_sec=60):
+        """Count workers whose coordination-service heartbeat is stale
+        (ref contract: kvstore_dist.h:159-168 GetDeadNodes)."""
+        if self._heartbeat is None:
+            return 0
+        return self._heartbeat.dead_nodes(self._size, timeout_sec)
 
     @property
     def rank(self):

@@ -12,8 +12,10 @@ from .resnet import get_symbol as resnet
 from .alexnet import get_symbol as alexnet
 from .vgg import get_symbol as vgg
 from .inception_bn import get_symbol as inception_bn
+from .transformer import get_symbol as transformer
 
 _FACTORIES = {
+    "transformer": transformer,
     "lenet": lenet,
     "mlp": mlp,
     "resnet": resnet,

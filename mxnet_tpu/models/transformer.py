@@ -1,0 +1,55 @@
+"""Decoder-only transformer LM — the long-context flagship model.
+
+Supersedes the reference's model-parallel LSTM as the long-sequence story
+(ref pattern being replaced: example/model-parallel-lstm/lstm.py:48-112;
+SURVEY.md §5): blockwise attention on one chip, ring or Ulysses sequence
+parallelism over the mesh 'seq' axis (``seq_parallel`` attr on
+MultiHeadAttention), data/tensor parallelism via the ambient mesh.
+
+Pre-LN blocks: x + MHA(LN(x)); x + FFN(LN(x)); loss is per-position
+softmax cross-entropy over the vocabulary.
+"""
+from .. import symbol as sym
+
+
+def _ffn(x, embed, hidden, name):
+    h = sym.Reshape(data=x, shape=(-1, embed))
+    h = sym.FullyConnected(data=h, num_hidden=hidden, name=name + "_fc1")
+    h = sym.Activation(data=h, act_type="relu")
+    h = sym.FullyConnected(data=h, num_hidden=embed, name=name + "_fc2")
+    return h
+
+
+def get_symbol(vocab_size=256, embed=128, num_heads=4, num_layers=2,
+               seq_len=128, ffn_hidden=None, causal=True, seq_parallel="",
+               block_size=0, dropout=0.0, **kwargs):
+    """Returns the LM symbol; data (batch, seq) int tokens, label
+    (batch, seq) next-token ids."""
+    ffn_hidden = ffn_hidden or 4 * embed
+    data = sym.Variable("data")
+    pos = sym.Variable("pos_embed_weight", shape=(seq_len, embed))
+    tok = sym.Embedding(data=data, input_dim=vocab_size, output_dim=embed,
+                        name="tok_embed")
+    x = sym.broadcast_add(tok, sym.expand_dims(pos, axis=0))
+    for i in range(num_layers):
+        name = "layer%d" % i
+        a = sym.LayerNorm(data=x, name=name + "_ln1")
+        a = sym.MultiHeadAttention(data=a, num_heads=num_heads,
+                                   causal=causal, seq_parallel=seq_parallel,
+                                   block_size=block_size,
+                                   name=name + "_attn")
+        if dropout > 0:
+            a = sym.Dropout(data=a, p=dropout)
+        x = x + a
+        f = sym.LayerNorm(data=x, name=name + "_ln2")
+        f = _ffn(f, embed, ffn_hidden, name + "_ffn")
+        f = sym.Reshape(data=f, shape=(-1, seq_len, embed))
+        if dropout > 0:
+            f = sym.Dropout(data=f, p=dropout)
+        x = x + f
+    x = sym.LayerNorm(data=x, name="final_ln")
+    x = sym.Reshape(data=x, shape=(-1, embed))
+    logits = sym.FullyConnected(data=x, num_hidden=vocab_size, name="lm_head")
+    label = sym.Variable("softmax_label")
+    label = sym.Reshape(data=label, shape=(-1,))
+    return sym.SoftmaxOutput(data=logits, label=label, name="softmax")

@@ -20,6 +20,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
 
 from ..base import attr_bool, attr_float, attr_int, attr_tuple, attr_str, MXNetError
 from .registry import OpDef, register, register_def
@@ -59,7 +60,8 @@ def _fc(op_ctx, attrs, inputs, aux):
     y = jnp.dot(x, w.T)
     if not no_bias:
         y = y + inputs[2]
-    return (y,)
+    # remat anchor (see Convolution): saved under TrainStep(remat="conv")
+    return (_ckpt_name(y, "fc_out"),)
 
 
 _FC = register_def(OpDef("FullyConnected", _fc, inputs=("data", "weight", "bias"),
@@ -91,27 +93,43 @@ def _conv_inputs(attrs):
     return ["data", "weight", "bias"]
 
 
+def _conv_layout(attrs, nd):
+    """Activation layout. NHWC (2-d only) keeps the channel dim innermost —
+    the TPU-preferred layout that also makes a 1x1 conv a free reshape to a
+    matmul (the Pallas conv+BN-stats fusion requires it). Weights stay OIHW
+    in every layout so checkpoints transfer."""
+    layout = attr_str(attrs.get("layout", ""), "")
+    if not layout:
+        return "NCHW" if nd == 2 else ("NCW" if nd == 1 else "NCDHW")
+    if layout not in ("NCHW", "NHWC") or nd != 2:
+        raise MXNetError("Convolution: unsupported layout %r" % layout)
+    return layout
+
+
 def _conv_infer(attrs, in_shapes):
     kernel, stride, dilate, pad, nf, ng, no_bias = _conv_attrs(attrs)
     data = in_shapes[0]
     if data is None:
         raise MXNetError("Convolution: data shape required")
-    c = data[1]
+    nhwc = _conv_layout(attrs, len(kernel)) == "NHWC"
+    c = data[-1] if nhwc else data[1]
     wshape = (nf, c // ng) + kernel
     out_sp = tuple(
-        (data[2 + i] + 2 * pad[i] - dilate[i] * (kernel[i] - 1) - 1) // stride[i] + 1
+        (data[(1 if nhwc else 2) + i] + 2 * pad[i]
+         - dilate[i] * (kernel[i] - 1) - 1) // stride[i] + 1
         for i in range(len(kernel)))
     shapes = [tuple(data), wshape] + ([] if no_bias else [(nf,)])
-    return shapes, [(data[0], nf) + out_sp], []
+    out = ((data[0],) + out_sp + (nf,)) if nhwc else ((data[0], nf) + out_sp)
+    return shapes, [out], []
 
 
 def _conv(op_ctx, attrs, inputs, aux):
     kernel, stride, dilate, pad, nf, ng, no_bias = _conv_attrs(attrs)
     x, w = inputs[0], inputs[1]
     nd = len(kernel)
+    layout = _conv_layout(attrs, nd)
     dn = jax.lax.conv_dimension_numbers(
-        x.shape, w.shape,
-        ("NCHW", "OIHW", "NCHW") if nd == 2 else
+        x.shape, w.shape, (layout, "OIHW", layout) if nd == 2 else
         ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
     # no preferred_element_type: the MXU accumulates bf16 matmuls in fp32
     # internally, and a widened output dtype breaks the conv transpose rule
@@ -119,9 +137,13 @@ def _conv(op_ctx, attrs, inputs, aux):
         x, w, window_strides=stride, padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=ng)
     if not no_bias:
-        b = inputs[2].reshape((1, nf) + (1,) * nd)
-        y = y + b
-    return (y,)
+        bshape = ((1,) * (nd + 1) + (nf,)) if layout == "NHWC" \
+            else ((1, nf) + (1,) * nd)
+        y = y + inputs[2].reshape(bshape)
+    # remat anchor: under TrainStep(remat="conv") only these outputs are
+    # saved for backward; BN/ReLU/pool between convs are recomputed, cutting
+    # stored-activation HBM traffic (no-op outside jax.checkpoint)
+    return (_ckpt_name(y, "conv_out"),)
 
 
 _CONV = register_def(OpDef("Convolution", _conv, inputs=("data", "weight", "bias"),
@@ -271,7 +293,8 @@ def _bn_infer(attrs, in_shapes):
     data = in_shapes[0]
     if data is None:
         raise MXNetError("BatchNorm: data shape required")
-    c = data[1] if len(data) > 1 else data[0]
+    axis = attr_int(attrs.get("axis", 1), 1)
+    c = data[axis] if len(data) > 1 else data[0]
     out_mv = attr_bool(attrs.get("output_mean_var", False), False)
     outs = [tuple(data)] + ([(c,), (c,)] if out_mv else [])
     return [tuple(data), (c,), (c,)], outs, [(c,), (c,)]
@@ -291,11 +314,29 @@ def _batch_norm(op_ctx, attrs, inputs, aux):
     out_mv = attr_bool(attrs.get("output_mean_var", False), False)
     x, gamma, beta = inputs
     moving_mean, moving_var = aux
-    red = tuple(i for i in range(x.ndim) if i != 1)
-    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    axis = attr_int(attrs.get("axis", 1), 1) % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = tuple(-1 if i == axis else 1 for i in range(x.ndim))
     if fix_gamma:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
-    if op_ctx.is_train and not use_global:
+    fused = getattr(op_ctx, "fused_stats", None)
+    if op_ctx.is_train and not use_global and fused is not None:
+        # batch statistics precomputed by a fused producer (the Pallas
+        # conv+stats epilogue): sum and sum-of-squares over the reduce axes,
+        # f32. Differentiable — cotangents flow to the producer's vjp.
+        s1, s2, count = fused
+        mean32 = s1 / count
+        var32 = jnp.maximum(s2 / count - jnp.square(mean32), 0.0)
+        mean = mean32.astype(x.dtype)
+        var = var32.astype(x.dtype)
+        new_mean = (momentum * moving_mean
+                    + (1 - momentum) * jax.lax.stop_gradient(
+                        mean32.astype(moving_mean.dtype)))
+        new_var = (momentum * moving_var
+                   + (1 - momentum) * jax.lax.stop_gradient(
+                       var32.astype(moving_var.dtype)))
+        aux_updates = (new_mean, new_var)
+    elif op_ctx.is_train and not use_global:
         if x.dtype in (jnp.bfloat16, jnp.float16):
             # One-pass statistics: sum and sum-of-squares reduce in a SINGLE
             # fused read of x (f32 accumulation), vs the mean-then-var
@@ -400,27 +441,44 @@ def _pool_out_dim(in_dim, k, s, p, convention):
     return (in_dim + 2 * p - k) // s + 1
 
 
+def _pool_nhwc(attrs):
+    layout = attr_str(attrs.get("layout", ""), "")
+    if layout and layout not in ("NCHW", "NHWC"):
+        raise MXNetError("Pooling: unsupported layout %r" % layout)
+    return layout == "NHWC"
+
+
 def _pool_infer(attrs, in_shapes):
     data = in_shapes[0]
     if data is None:
         raise MXNetError("Pooling: data shape required")
+    nhwc = _pool_nhwc(attrs)
     if attr_bool(attrs.get("global_pool", False), False):
+        if nhwc:
+            return [tuple(data)], [(data[0],) + (1,) * (len(data) - 2)
+                                   + (data[-1],)], []
         return [tuple(data)], [tuple(data[:2]) + (1,) * (len(data) - 2)], []
     kernel = attr_tuple(attrs["kernel"])
     nd = len(kernel)
     stride = attr_tuple(attrs.get("stride", (1,) * nd), (1,) * nd)
     pad = attr_tuple(attrs.get("pad", (0,) * nd), (0,) * nd)
     conv = attr_str(attrs.get("pooling_convention", "valid"), "valid")
-    out_sp = tuple(_pool_out_dim(data[2 + i], kernel[i], stride[i], pad[i], conv)
+    sp0 = 1 if nhwc else 2
+    out_sp = tuple(_pool_out_dim(data[sp0 + i], kernel[i], stride[i], pad[i],
+                                 conv)
                    for i in range(nd))
+    if nhwc:
+        return [tuple(data)], [(data[0],) + out_sp + (data[-1],)], []
     return [tuple(data)], [tuple(data[:2]) + out_sp], []
 
 
 def _pooling(op_ctx, attrs, inputs, aux):
     x = inputs[0]
     ptype = attr_str(attrs.get("pool_type", "max"), "max")
+    nhwc = _pool_nhwc(attrs)
     if attr_bool(attrs.get("global_pool", False), False):
-        red = tuple(range(2, x.ndim))
+        red = (tuple(range(1, x.ndim - 1)) if nhwc
+               else tuple(range(2, x.ndim)))
         if ptype == "max":
             return (jnp.max(x, axis=red, keepdims=True),)
         if ptype == "sum":
@@ -432,13 +490,21 @@ def _pooling(op_ctx, attrs, inputs, aux):
     pad = attr_tuple(attrs.get("pad", (0,) * nd), (0,) * nd)
     conv = attr_str(attrs.get("pooling_convention", "valid"), "valid")
     # explicit padding incl. ceil-mode extra on the high side
-    pads = [(0, 0), (0, 0)]
+    sp0 = 1 if nhwc else 2
+    pads = [(0, 0)]
     for i in range(nd):
-        out = _pool_out_dim(x.shape[2 + i], kernel[i], stride[i], pad[i], conv)
-        needed = (out - 1) * stride[i] + kernel[i] - x.shape[2 + i]
+        out = _pool_out_dim(x.shape[sp0 + i], kernel[i], stride[i], pad[i],
+                            conv)
+        needed = (out - 1) * stride[i] + kernel[i] - x.shape[sp0 + i]
         pads.append((pad[i], max(pad[i], needed - pad[i])))
-    wdims = (1, 1) + kernel
-    wstrides = (1, 1) + stride
+    if nhwc:
+        pads = pads + [(0, 0)]
+        wdims = (1,) + kernel + (1,)
+        wstrides = (1,) + stride + (1,)
+    else:
+        pads = [pads[0]] + [(0, 0)] + pads[1:]
+        wdims = (1, 1) + kernel
+        wstrides = (1, 1) + stride
     if ptype == "max":
         # init must be a python literal, not a traced array — JAX's
         # reduce_window vjp rule only fires on the recognized monoid

@@ -35,11 +35,14 @@ class OpContext(object):
     ResourceRequest::kRandom).
     """
 
-    __slots__ = ("is_train", "rng")
+    __slots__ = ("is_train", "rng", "fused_stats")
 
-    def __init__(self, is_train=False, rng=None):
+    def __init__(self, is_train=False, rng=None, fused_stats=None):
         self.is_train = is_train
         self.rng = rng
+        # (s1, s2, count) batch statistics precomputed by a fused producer
+        # (ops/pallas_fused.py); consumed by BatchNorm's fused_stats path
+        self.fused_stats = fused_stats
 
 
 class OpDef(object):

@@ -110,6 +110,7 @@ class TrainStep(object):
             self._auto_group_params = {}
         self._needs_rng = any((not n.is_variable) and n.op.needs_rng
                               for n in self._nodes)
+        self.remat = remat
         if remat:
             self._run = self._wrap_remat(self._run)
         self._jit = {}  # keyed by batch size (rescale_grad depends on it)
@@ -118,12 +119,25 @@ class TrainStep(object):
     # ------------------------------------------------------------------
     def _wrap_remat(self, run):
         """Memory mirroring: recompute activations in backward
-        (ref: MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:213-226 — here a
-        single jax.checkpoint over the whole forward)."""
+        (ref: MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:213-226).
+
+        remat=True: a single jax.checkpoint over the whole forward (minimum
+        memory, full recompute). remat="conv": save only Convolution /
+        FullyConnected outputs (the ``conv_out``/``fc_out`` checkpoint_name
+        anchors in ops/nn.py) and recompute the elementwise chain between
+        them (BN normalize, ReLU, pad/pool) in backward — on a
+        bandwidth-bound chip this trades cheap VPU FLOPs for one fewer
+        HBM round-trip per saved activation."""
+        if self.remat == "conv":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "conv_out", "fc_out")
+        else:
+            policy = None
+
         def wrapped(arg_vals, aux_vals, key, is_train):
             def inner(arg_vals):
                 return run(arg_vals, aux_vals, key, is_train)
-            return jax.checkpoint(inner)(arg_vals)
+            return jax.checkpoint(inner, policy=policy)(arg_vals)
         return wrapped
 
     # ------------------------------------------------------------------
@@ -213,7 +227,10 @@ class TrainStep(object):
         return out
 
     def shard_batch(self, batch):
-        """Place batch arrays with dim-0 sharded along the data axis.
+        """Place batch arrays with dim-0 sharded along the data axis; when
+        the mesh also has a 'seq' axis, dim-1 of rank>=2 arrays is sharded
+        along it (sequence/context parallelism — the token dim feeds the
+        ring/Ulysses attention shards).
 
         On a multi-host mesh each process passes its LOCAL batch shard and
         the global batch is their concatenation — the dist_sync data
@@ -221,12 +238,25 @@ class TrainStep(object):
         part_index/num_parts)."""
         if self.mesh is None:
             return batch
-        from .parallel.mesh import is_multiprocess, host_to_global
+        from .parallel.mesh import is_multiprocess, host_to_global, AXIS_SEQ
+        has_seq = AXIS_SEQ in self.mesh.axis_names
+        bax = "data" if "data" in self.mesh.axis_names else None
+
+        def spec_for(v):
+            nd = getattr(v, "ndim", None)
+            if nd is None:
+                nd = np.asarray(v).ndim
+            if has_seq and nd >= 2:
+                return P(bax, AXIS_SEQ)
+            return P(bax)
+
         if is_multiprocess(self.mesh):
-            return {k: host_to_global(self.mesh, P("data"), v)
+            return {k: host_to_global(self.mesh, spec_for(v), v)
                     for k, v in batch.items()}
-        s = jax.sharding.NamedSharding(self.mesh, P("data"))
-        return {k: jax.device_put(jnp.asarray(v), s) for k, v in batch.items()}
+        return {k: jax.device_put(
+            jnp.asarray(v),
+            jax.sharding.NamedSharding(self.mesh, spec_for(v)))
+            for k, v in batch.items()}
 
     # ------------------------------------------------------------------
     def _build(self, batch_size):

@@ -1,0 +1,139 @@
+"""MultiHeadAttention / LayerNorm ops and sequence-parallel execution.
+
+The long-context flagship surface (SURVEY.md §5; supersedes
+example/model-parallel-lstm). Ring/Ulysses numerics run on the virtual
+8-device CPU mesh (conftest).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.train_step import TrainStep
+from mxnet_tpu.parallel.mesh import make_mesh, MeshScope
+
+
+def _naive_mha(x, wqkv, bqkv, wout, bout, H, causal):
+    B, S, E = x.shape
+    d = E // H
+    qkv = x @ wqkv.T + bqkv
+    q, k, v = [qkv[:, :, i * E:(i + 1) * E].reshape(B, S, H, d)
+               .transpose(0, 2, 1, 3) for i in range(3)]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3)
+    return o.reshape(B, S, E) @ wout.T + bout
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_mha_matches_naive(causal):
+    rng = np.random.RandomState(0)
+    B, S, E, H = 2, 16, 32, 4
+    x = rng.randn(B, S, E).astype(np.float32)
+    wqkv = (rng.randn(3 * E, E) * 0.1).astype(np.float32)
+    bqkv = rng.randn(3 * E).astype(np.float32) * 0.1
+    wout = (rng.randn(E, E) * 0.1).astype(np.float32)
+    bout = rng.randn(E).astype(np.float32) * 0.1
+    out = mx.nd.MultiHeadAttention(
+        mx.nd.array(x), mx.nd.array(wqkv), mx.nd.array(bqkv),
+        mx.nd.array(wout), mx.nd.array(bout),
+        num_heads=H, causal=causal).asnumpy()
+    ref = _naive_mha(x, wqkv, bqkv, wout, bout, H, causal)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_mha_no_bias_and_infer_shape():
+    data = mx.sym.Variable("data")
+    att = mx.sym.MultiHeadAttention(data=data, num_heads=4, no_bias=True,
+                                    name="att")
+    assert att.list_arguments() == ["data", "att_qkv_weight",
+                                    "att_out_weight"]
+    arg, out, _ = att.infer_shape(data=(2, 8, 16))
+    assert arg == [(2, 8, 16), (48, 16), (16, 16)]
+    assert out == [(2, 8, 16)]
+
+
+def test_mha_invalid_heads():
+    data = mx.sym.Variable("data")
+    att = mx.sym.MultiHeadAttention(data=data, num_heads=5)
+    with pytest.raises(MXNetError, match="num_heads"):
+        att.infer_shape(data=(2, 8, 16))
+
+
+def test_mha_seq_parallel_needs_mesh():
+    x = np.zeros((2, 8, 16), np.float32)
+    w = np.zeros((48, 16), np.float32)
+    o = np.zeros((16, 16), np.float32)
+    with pytest.raises(MXNetError, match="seq"):
+        mx.nd.MultiHeadAttention(mx.nd.array(x), mx.nd.array(w),
+                                 mx.nd.array(o), num_heads=4, no_bias=True,
+                                 seq_parallel="ring")
+
+
+def test_layer_norm_matches_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 5, 8).astype(np.float32)
+    g = rng.rand(8).astype(np.float32) + 0.5
+    b = rng.randn(8).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          eps=1e-5).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * g + b
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    data = mx.sym.Variable("data")
+    g = mx.sym.Variable("gamma")
+    b = mx.sym.Variable("beta")
+    net = mx.sym.LayerNorm(data=data, gamma=g, beta=b)
+    check_numeric_gradient(net, {"data": np.random.rand(2, 3, 4).astype(
+        np.float32), "gamma": np.ones(4, np.float32),
+        "beta": np.zeros(4, np.float32)})
+
+
+def _one_step(mode, mesh, B=4, S=32, V=32, E=32):
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, V, (B, S)).astype(np.float32)
+    label = rng.randint(0, V, (B, S)).astype(np.float32)
+    sym = models.transformer(vocab_size=V, embed=E, num_heads=4,
+                             num_layers=2, seq_len=S, seq_parallel=mode)
+    scope = MeshScope(mesh) if mesh is not None else None
+    if scope:
+        scope.__enter__()
+    try:
+        step = TrainStep(sym, optimizer="sgd", learning_rate=0.1, mesh=mesh)
+        st = step.init({"data": (B, S)}, {"softmax_label": (B, S)}, seed=3)
+        batch = {"data": data, "softmax_label": label}
+        if mesh is not None:
+            batch = step.shard_batch(batch)
+        st2, _ = step.step(st, batch)
+        return {k: np.asarray(v, np.float32)
+                for k, v in st2["params"].items()}
+    finally:
+        if scope:
+            scope.__exit__(None, None, None)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_seq_parallel_one_step_matches_single_device(mode):
+    base = _one_step("", None)
+    mesh = make_mesh({"data": 2, "seq": 4})
+    got = _one_step(mode, mesh)
+    for k in base:
+        np.testing.assert_allclose(base[k], got[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_transformer_symbol_json_roundtrip():
+    sym = models.transformer(vocab_size=32, embed=32, num_heads=4,
+                             num_layers=1, seq_len=16)
+    back = mx.sym.load_json(sym.tojson())
+    assert back.list_arguments() == sym.list_arguments()

@@ -96,10 +96,13 @@ def test_copy_params_and_reshape():
     w = np.random.rand(3, 5).astype(np.float32)
     ex.copy_params_from({"fc_weight": nd.array(w)}, allow_extra_params=True)
     assert np.allclose(ex.arg_dict["fc_weight"].asnumpy(), w)
-    ex2 = ex.reshape(data=(8, 5))
+    # growing an array needs allow_up_sizing (ref executor.py reshape)
+    ex2 = ex.reshape(data=(8, 5), allow_up_sizing=True)
     assert ex2.arg_dict["data"].shape == (8, 5)
     # weights shared
     assert np.allclose(ex2.arg_dict["fc_weight"].asnumpy(), w)
+    ex3 = ex.reshape(data=(2, 5))  # shrinking needs no flag
+    assert ex3.arg_dict["data"].shape == (2, 5)
 
 
 def test_monitor_callback():

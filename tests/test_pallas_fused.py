@@ -1,0 +1,221 @@
+"""Pallas conv+BN-stats fusion and NHWC layout support.
+
+Kernel numerics run in pallas interpret mode (CPU); the executor fusion
+pass is exercised end-to-end with MXTPU_FUSE_CONV_BN=interpret.
+Ref role: cuDNN fused conv+BN epilogues (src/operator/cudnn_batch_norm-inl.h).
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import pallas_fused as pf
+
+
+def test_matmul_stats_forward():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 32), jnp.float32)
+    w = jnp.asarray(rng.randn(32, 128), jnp.float32)
+    y, s1, s2 = pf.matmul_stats(x, w, True)
+    yr = np.asarray(x) @ np.asarray(w)
+    np.testing.assert_allclose(np.asarray(y), yr, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1), yr.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s2), (yr * yr).sum(0), rtol=1e-4)
+
+
+def test_matmul_stats_grad_vs_reference():
+    rng = np.random.RandomState(1)
+    M, K, N = 32, 16, 128
+    x = jnp.asarray(rng.randn(M, K), jnp.float32)
+    w = jnp.asarray(rng.randn(K, N), jnp.float32)
+    t = jnp.asarray(rng.randn(M, N), jnp.float32)
+
+    def loss(fused):
+        def f(x, w):
+            if fused:
+                y, s1, s2 = pf.matmul_stats(x, w, True)
+            else:
+                y = x @ w
+                s1, s2 = jnp.sum(y, 0), jnp.sum(y * y, 0)
+            mean = s1 / M
+            var = s2 / M - mean ** 2
+            z = (y - mean[None]) * jax.lax.rsqrt(var[None] + 1e-5)
+            return jnp.sum(z * t)
+        return jax.grad(f, argnums=(0, 1))(x, w)
+
+    for a, b in zip(loss(True), loss(False)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_matmul_stats_fallback_shapes():
+    # N not 128-aligned and M with no 16-divisor: XLA fallback, same results
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(30, 8), jnp.float32)
+    w = jnp.asarray(rng.randn(8, 24), jnp.float32)
+    y, s1, s2 = pf.matmul_stats(x, w, True)
+    yr = np.asarray(x) @ np.asarray(w)
+    np.testing.assert_allclose(np.asarray(y), yr, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1), yr.sum(0), rtol=1e-4)
+
+
+def test_tile_m():
+    assert pf._tile_m(401408) == 1024
+    assert pf._tile_m(25088) == 896
+    assert pf._tile_m(6272) == 896
+    assert pf._tile_m(1568) == 784
+    assert pf._tile_m(7) is None
+
+
+def test_conv1x1_fusable_predicate():
+    ok = {"kernel": "(1, 1)", "no_bias": "True", "layout": "NHWC"}
+    assert pf.conv1x1_fusable(ok)
+    assert not pf.conv1x1_fusable({**ok, "layout": "NCHW"})
+    assert not pf.conv1x1_fusable({**ok, "kernel": "(3, 3)"})
+    assert not pf.conv1x1_fusable({**ok, "stride": "(2, 2)"})
+    assert not pf.conv1x1_fusable({**ok, "no_bias": "False"})
+    assert not pf.conv1x1_fusable({**ok, "num_group": "2"})
+
+
+@pytest.mark.parametrize("op", ["conv", "pool_max", "pool_avg", "global"])
+def test_nhwc_matches_nchw(op):
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 8, 10, 10).astype(np.float32)   # NCHW
+    xh = np.transpose(x, (0, 2, 3, 1)).copy()
+    if op == "conv":
+        w = rng.randn(16, 8, 3, 3).astype(np.float32)
+        a = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                              kernel=(3, 3), pad=(1, 1), stride=(2, 2),
+                              num_filter=16, no_bias=True).asnumpy()
+        b = mx.nd.Convolution(mx.nd.array(xh), mx.nd.array(w),
+                              kernel=(3, 3), pad=(1, 1), stride=(2, 2),
+                              num_filter=16, no_bias=True,
+                              layout="NHWC").asnumpy()
+        b = np.transpose(b, (0, 3, 1, 2))
+    elif op.startswith("pool"):
+        pt = op.split("_")[1]
+        a = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3), stride=(2, 2),
+                          pad=(1, 1), pool_type=pt).asnumpy()
+        b = mx.nd.Pooling(mx.nd.array(xh), kernel=(3, 3), stride=(2, 2),
+                          pad=(1, 1), pool_type=pt, layout="NHWC").asnumpy()
+        b = np.transpose(b, (0, 3, 1, 2))
+    else:
+        a = mx.nd.Pooling(mx.nd.array(x), global_pool=True, kernel=(1, 1),
+                          pool_type="avg").asnumpy()
+        b = mx.nd.Pooling(mx.nd.array(xh), global_pool=True, kernel=(1, 1),
+                          pool_type="avg", layout="NHWC").asnumpy()
+        b = np.transpose(b, (0, 3, 1, 2))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_axis_last():
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 6, 5, 5).astype(np.float32)
+    xh = np.transpose(x, (0, 2, 3, 1)).copy()
+    g = rng.rand(6).astype(np.float32) + 0.5
+    bt = rng.rand(6).astype(np.float32)
+    kw = dict(fix_gamma=False, eps=2e-5)
+    a = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(bt),
+                        mx.nd.zeros((6,)), mx.nd.ones((6,)), **kw).asnumpy()
+    b = mx.nd.BatchNorm(mx.nd.array(xh), mx.nd.array(g), mx.nd.array(bt),
+                        mx.nd.zeros((6,)), mx.nd.ones((6,)), axis=3,
+                        **kw).asnumpy()
+    np.testing.assert_allclose(a, np.transpose(b, (0, 3, 1, 2)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def _tiny_grads(fuse, monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSE_CONV_BN", fuse)
+    np.random.seed(5)
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=data, num_filter=128, kernel=(1, 1),
+                           no_bias=True, layout="NHWC", name="c")
+    bn = mx.sym.BatchNorm(data=c, axis=3, fix_gamma=False, eps=2e-5,
+                          name="bn")
+    r = mx.sym.Activation(data=bn, act_type="relu")
+    out = mx.sym.MakeLoss(mx.sym.sum(r))
+    x = np.random.rand(2, 8, 8, 64).astype(np.float32)
+    wv = (np.random.randn(128, 64, 1, 1) * 0.1).astype(np.float32)
+    args = {"data": mx.nd.array(x), "c_weight": mx.nd.array(wv),
+            "bn_gamma": mx.nd.ones((128,)), "bn_beta": mx.nd.zeros((128,))}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    exe = out.bind(mx.cpu(), args, args_grad=grads,
+                   aux_states={"bn_moving_mean": mx.nd.zeros((128,)),
+                               "bn_moving_var": mx.nd.ones((128,))})
+    exe.forward(is_train=True)
+    exe.backward()
+    return ({k: v.asnumpy() for k, v in grads.items()},
+            exe.outputs[0].asnumpy())
+
+
+def test_executor_fusion_end_to_end(monkeypatch):
+    g0, o0 = _tiny_grads("0", monkeypatch)
+    g1, o1 = _tiny_grads("interpret", monkeypatch)
+    np.testing.assert_allclose(o0, o1, rtol=1e-4, atol=1e-4)
+    for k in g0:
+        np.testing.assert_allclose(g0[k], g1[k], rtol=1e-3, atol=1e-3)
+
+
+def test_fusion_skips_eval_mode(monkeypatch):
+    """In eval, BN uses moving stats; the fused path must not activate."""
+    monkeypatch.setenv("MXTPU_FUSE_CONV_BN", "interpret")
+    np.random.seed(6)
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=data, num_filter=128, kernel=(1, 1),
+                           no_bias=True, layout="NHWC", name="c")
+    bn = mx.sym.BatchNorm(data=c, axis=3, name="bn")
+    x = np.random.rand(2, 4, 4, 64).astype(np.float32)
+    wv = (np.random.randn(128, 64, 1, 1) * 0.1).astype(np.float32)
+    args = {"data": mx.nd.array(x), "c_weight": mx.nd.array(wv),
+            "bn_gamma": mx.nd.ones((128,)), "bn_beta": mx.nd.zeros((128,))}
+    mean = np.random.rand(128).astype(np.float32)
+    var = np.random.rand(128).astype(np.float32) + 0.5
+    exe = bn.bind(mx.cpu(), args,
+                  aux_states={"bn_moving_mean": mx.nd.array(mean),
+                              "bn_moving_var": mx.nd.array(var)})
+    exe.forward(is_train=False)
+    got = exe.outputs[0].asnumpy()
+    y = (np.transpose(x, (0, 3, 1, 2)).reshape(2, 64, -1).transpose(1, 0, 2)
+         .reshape(64, -1).T @ wv.reshape(128, 64).T)
+    y = y.reshape(2, 4, 4, 128)
+    ref = (y - mean) / np.sqrt(var + 1e-3)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_resnet_nhwc_one_step_close_to_nchw():
+    """Full resnet-18 train step NHWC(+fusion interpret) vs NCHW: aux stats
+    must match tightly; params to loose tolerance (roundoff chaos through
+    depth is expected — the f64 check in docs/perf.md shows 1e-13 algebraic
+    agreement)."""
+    from mxnet_tpu import models
+    from mxnet_tpu.train_step import TrainStep
+    os.environ["MXTPU_FUSE_CONV_BN"] = "interpret"
+    try:
+        np.random.seed(0)
+        B, H = 2, 16
+        res = {}
+        for layout in ("NCHW", "NHWC"):
+            sym = models.resnet(num_classes=4, num_layers=18,
+                                image_shape="3,%d,%d" % (H, H),
+                                layout=layout)
+            shp = (B, 3, H, H) if layout == "NCHW" else (B, H, H, 3)
+            step = TrainStep(sym, optimizer="sgd", learning_rate=0.01)
+            st = step.init({"data": shp}, {"softmax_label": (B,)}, seed=3)
+            x = np.random.RandomState(1).rand(B, 3, H, H).astype(np.float32)
+            if layout == "NHWC":
+                xin = np.transpose(x, (0, 2, 3, 1)).copy()
+            else:
+                xin = x
+            yv = np.array([0, 1], np.float32)
+            st2, _ = step.step(st, {"data": xin, "softmax_label": yv})
+            res[layout] = st2
+        a, b = res["NCHW"], res["NHWC"]
+        for k in a["aux"]:
+            np.testing.assert_allclose(np.asarray(a["aux"][k]),
+                                       np.asarray(b["aux"][k]),
+                                       rtol=1e-3, atol=1e-3)
+    finally:
+        os.environ.pop("MXTPU_FUSE_CONV_BN", None)

@@ -1,0 +1,163 @@
+"""Round-4 API-parity fills: PythonModule, FusedRNN initializer,
+Executor.reshape flag semantics, heartbeat num_dead_node, signal handler.
+Refs: python/mxnet/module/python_module.py, python/mxnet/initializer.py
+(FusedRNN), python/mxnet/executor.py (reshape), src/kvstore/
+kvstore_dist.h:159-168, src/initialize.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# PythonModule / PythonLossModule
+# ---------------------------------------------------------------------------
+def test_python_loss_module_forward_backward():
+    def grad_func(scores, labels):
+        return scores - labels
+
+    m = mx.mod.PythonLossModule(grad_func=grad_func)
+    m.bind(data_shapes=[("data", (4, 3))],
+           label_shapes=[("softmax_label", (4, 3))])
+    assert m.output_shapes == [("pyloss_output", (4, 3))]
+    from mxnet_tpu.io import DataBatch
+    s = mx.nd.array(np.ones((4, 3), np.float32) * 2)
+    l = mx.nd.array(np.ones((4, 3), np.float32))
+    m.forward(DataBatch(data=[s], label=[l]))
+    assert m.get_outputs()[0] is s
+    m.backward()
+    np.testing.assert_array_equal(m.get_input_grads()[0].asnumpy(),
+                                  np.ones((4, 3), np.float32))
+
+
+def test_python_module_bind_contract():
+    m = mx.mod.PythonLossModule()
+    with pytest.raises(ValueError):
+        m.bind(data_shapes=[("wrong_name", (2, 2))])
+    m.bind(data_shapes=[("data", (2, 2))],
+           label_shapes=[("softmax_label", (2, 2))])
+    # rebind without force is a warning no-op
+    m.bind(data_shapes=[("data", (8, 8))],
+           label_shapes=[("softmax_label", (8, 8))])
+    assert m.data_shapes[0][1] == (2, 2)
+    assert m.get_params() == ({}, {})
+
+
+def test_python_loss_module_no_grad_func():
+    m = mx.mod.PythonLossModule()
+    m.bind(data_shapes=[("data", (2, 2))],
+           label_shapes=[("softmax_label", (2, 2))])
+    from mxnet_tpu.io import DataBatch
+    m.forward(DataBatch(data=[mx.nd.ones((2, 2))],
+                        label=[mx.nd.ones((2, 2))]))
+    with pytest.raises(NotImplementedError):
+        m.backward()
+
+
+# ---------------------------------------------------------------------------
+# FusedRNN initializer
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode,bid", [("lstm", False), ("gru", False),
+                                      ("lstm", True)])
+def test_fused_rnn_initializer(mode, bid):
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    h, nl, isz = 8, 2, 4
+    n = rnn_param_size(mode=mode, input_size=isz, state_size=h,
+                       num_layers=nl, bidirectional=bid)
+    arr = mx.nd.zeros((n,))
+    init = mx.initializer.FusedRNN(mx.initializer.Xavier(), h, nl, mode,
+                                   bidirectional=bid)
+    init(mx.initializer.InitDesc("rnn_parameters"), arr)
+    v = arr.asnumpy()
+    assert (v != 0).mean() > 0.5          # weights initialized
+    if mode == "lstm":
+        dirs = 2 if bid else 1
+        # forget-gate bias slice == 1.0 in i2h+h2h of every layer*dir
+        assert np.isclose(v, 1.0).sum() >= 2 * h * nl * dirs
+
+
+def test_fused_rnn_initializer_string_init_roundtrip():
+    init = mx.initializer.FusedRNN(mx.initializer.Uniform(0.1), 4, 1, "lstm")
+    init2 = mx.initializer.FusedRNN(mx.initializer.Uniform(0.1).dumps(),
+                                    4, 1, "lstm")
+    assert isinstance(init2._init, mx.initializer.Uniform)
+    assert "fusedrnn" in init.dumps()
+
+
+def test_fused_rnn_initializer_matches_unfused_cell_shapes():
+    """Unpacked-then-packed layout agrees with FusedRNNCell.unpack."""
+    from mxnet_tpu.rnn import rnn_cell
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    h, nl = 6, 2
+    n = rnn_param_size(mode="lstm", input_size=h, state_size=h,
+                       num_layers=nl, bidirectional=False)
+    arr = mx.nd.zeros((n,))
+    mx.initializer.FusedRNN(mx.initializer.One(), h, nl, "lstm")(
+        mx.initializer.InitDesc("p"), arr)
+    cell = rnn_cell.FusedRNNCell(h, nl, "lstm", prefix="")
+    args = cell.unpack_weights({"parameters": arr})
+    w = args["l0_i2h_weight"].asnumpy()
+    assert w.shape == (4 * h, h)
+    np.testing.assert_array_equal(w, np.ones_like(w))  # One() everywhere
+    b = args["l0_i2h_bias"].asnumpy()
+    np.testing.assert_array_equal(b[h:2 * h], np.ones(h))  # forget bias 1.0
+
+
+# ---------------------------------------------------------------------------
+# Executor.reshape flags
+# ---------------------------------------------------------------------------
+def _bound_fc(batch=4, hidden=8):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=hidden, name="fc")
+    return net.simple_bind(mx.cpu(), data=(batch, 6))
+
+
+def test_reshape_batch_ok():
+    ex = _bound_fc()
+    ex2 = ex.reshape(data=(2, 6))
+    assert ex2.arg_dict["data"].shape == (2, 6)
+    # weights shared, not reallocated
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+
+
+def test_reshape_up_sizing_requires_flag():
+    ex = _bound_fc(batch=4)
+    with pytest.raises(MXNetError, match="allow_up_sizing"):
+        ex.reshape(data=(16, 6))
+    ex2 = ex.reshape(data=(16, 6), allow_up_sizing=True)
+    assert ex2.arg_dict["data"].shape == (16, 6)
+
+
+def test_reshape_derived_shape_change_requires_partial_shaping():
+    ex = _bound_fc()
+    # feature-dim change forces fc_weight to change -> derived reshape
+    with pytest.raises(MXNetError, match="partial_shaping"):
+        ex.reshape(data=(4, 3))
+    ex2 = ex.reshape(data=(4, 3), partial_shaping=True)
+    assert ex2.arg_dict["fc_weight"].shape == (8, 3)
+
+
+# ---------------------------------------------------------------------------
+# num_dead_node heartbeat
+# ---------------------------------------------------------------------------
+def test_num_dead_node_local_zero():
+    kv = mx.kv.create("local")
+    assert kv.num_dead_node(1) == 0
+
+
+def test_heartbeat_no_client_is_quiet():
+    from mxnet_tpu.kvstore import _Heartbeat
+    hb = _Heartbeat(rank=0)
+    assert hb.dead_nodes(size=1, timeout_sec=1) == 0
+    hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# initialize
+# ---------------------------------------------------------------------------
+def test_signal_handler_installed():
+    import faulthandler
+    import mxnet_tpu.initialize  # noqa: F401  (import side effect)
+    assert faulthandler.is_enabled()

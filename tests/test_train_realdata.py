@@ -1,0 +1,89 @@
+"""End-to-end convergence on REAL images through the full data plane:
+JPEG -> RecordIO -> native fused decode/augment (ImageRecordIter) ->
+Module.fit conv net -> accuracy gate.
+
+Ref strategy: tests/python/train/test_conv.py (MNIST conv to 0.93) and
+tests/nightly/test_all.sh:44-67 (train jobs gated on validation accuracy).
+"""
+import io as _io
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+PIL = pytest.importorskip("PIL.Image")
+
+
+def _make_color_rec(path, n=256, h=64, w=64, seed=0):
+    """Color-separable 4-class dataset: class k has a dominant color patch
+    whose position/size jitter, so rand_crop/mirror keep it learnable but
+    non-trivial."""
+    colors = np.array([[200, 40, 40], [40, 200, 40], [40, 40, 200],
+                       [200, 200, 40]], np.float32)
+    rng = np.random.default_rng(seed)
+    idx = os.path.splitext(path)[0] + ".idx"
+    rec = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(n):
+        k = i % 4
+        img = rng.normal(110, 25, size=(h, w, 3))
+        img = (img + 0.55 * (colors[k] - 110)).clip(0, 255)
+        img = img.astype(np.uint8)
+        buf = _io.BytesIO()
+        PIL.fromarray(img).save(buf, format="JPEG", quality=92)
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(k), i, 0), buf.getvalue()))
+    rec.close()
+    return path
+
+
+def _small_convnet(num_classes=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data=data, num_filter=16, kernel=(3, 3),
+                             stride=(2, 2), pad=(1, 1), name="c1")
+    net = mx.sym.BatchNorm(data=net, fix_gamma=False, name="bn1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.Convolution(data=net, num_filter=32, kernel=(3, 3),
+                             stride=(2, 2), pad=(1, 1), name="c2")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.Pooling(data=net, global_pool=True, kernel=(1, 1),
+                         pool_type="avg")
+    net = mx.sym.Flatten(data=net)
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+@pytest.mark.skipif(not os.path.exists("lib/libmxtpu_io.so")
+                    and not os.path.exists("src/io/image_decode.cc"),
+                    reason="native IO library unavailable")
+def test_conv_convergence_on_real_images(tmp_path):
+    rec = _make_color_rec(str(tmp_path / "color.rec"))
+    train = mx.image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 48, 48), batch_size=32,
+        shuffle=True, rand_crop=True, rand_mirror=True, resize=56,
+        mean_r=110.0, mean_g=110.0, mean_b=110.0,
+        std_r=60.0, std_g=60.0, std_b=60.0, seed=1)
+    val = mx.image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 48, 48), batch_size=32,
+        resize=56,
+        mean_r=110.0, mean_g=110.0, mean_b=110.0,
+        std_r=60.0, std_g=60.0, std_b=60.0)
+    mod = mx.mod.Module(_small_convnet())
+    mod.fit(train, num_epoch=4,
+            initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    acc = mod.score(val, mx.metric.Accuracy())[0][1]
+    assert acc >= 0.9, "real-image convergence gate: acc %.3f < 0.9" % acc
+
+
+def test_record_iter_feeds_module_shapes(tmp_path):
+    rec = _make_color_rec(str(tmp_path / "c2.rec"), n=64)
+    it = mx.image.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                  batch_size=16, resize=40)
+    b = it.next()
+    assert b.data[0].shape == (16, 3, 32, 32)
+    assert b.label[0].shape == (16,)
+    assert it.provide_data[0].shape == (16, 3, 32, 32)
