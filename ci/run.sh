@@ -1,0 +1,12 @@
+#!/bin/sh
+# Local CI: same stages as ci/pipeline.yml (ref role: Jenkinsfile).
+set -e
+cd "$(dirname "$0")/.."
+make -C src
+make -C src/capi
+c++ -O2 -std=c++14 -I cpp-package/include cpp-package/example/train_mlp.cpp \
+    -L lib -lmxnet_tpu -Wl,-rpath,'$ORIGIN' -o lib/train_mlp_cpp
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/ -q
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "CI PASS"

@@ -1,0 +1,106 @@
+// Train a 2-layer MLP classifier in C++ over the mxnet-cpp header API
+// (ref: cpp-package/example/mlp.cpp). Build:
+//   c++ -O2 -std=c++14 -I cpp-package/include cpp-package/example/train_mlp.cpp \
+//       -L lib -lmxnet_tpu -Wl,-rpath,'$ORIGIN'/../lib -o lib/train_mlp_cpp
+#include <cmath>
+#include <cstdio>
+#include <random>
+
+#include "mxnet-cpp/mxnet_tpu.hpp"
+
+using namespace mxnet_tpu;
+
+static const int kN = 64, kIn = 8, kHidden = 16, kClasses = 2;
+
+int main() {
+  std::printf("mxnet_tpu (cpp) version %d\n", GetVersion());
+
+  // symbol: softmax(fc2(relu(fc1(x))))
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("label");
+  Symbol fc1 = Symbol::Create("FullyConnected",
+                              {{"num_hidden", std::to_string(kHidden)}},
+                              "fc1", {"data"}, {&data});
+  Symbol act = Symbol::Create("Activation", {{"act_type", "relu"}}, "relu1",
+                              {"data"}, {&fc1});
+  Symbol fc2 = Symbol::Create("FullyConnected",
+                              {{"num_hidden", std::to_string(kClasses)}},
+                              "fc2", {"data"}, {&act});
+  Symbol net = Symbol::Create("SoftmaxOutput", {}, "softmax",
+                              {"data", "label"}, {&fc2, &label});
+
+  auto args = net.ListArguments();
+  std::printf("args:");
+  for (auto &a : args) std::printf(" %s", a.c_str());
+  std::printf("\n");
+
+  // linearly separable two-class data
+  std::mt19937 gen(3);
+  std::uniform_real_distribution<float> U(0.f, 1.f);
+  std::vector<float> xs(kN * kIn), ys(kN);
+  for (int i = 0; i < kN; i++) {
+    float s = 0.f;
+    for (int j = 0; j < kIn; j++) {
+      xs[i * kIn + j] = U(gen);
+      s += xs[i * kIn + j] * ((j % 2) ? 1.f : -1.f);
+    }
+    ys[i] = s > 0.f ? 1.f : 0.f;
+  }
+
+  NDArray a_data({kN, kIn}), a_w1({kHidden, kIn}), a_b1({kHidden}),
+      a_w2({kClasses, kHidden}), a_b2({kClasses}), a_label({kN});
+  NDArray g_data({kN, kIn}), g_w1({kHidden, kIn}), g_b1({kHidden}),
+      g_w2({kClasses, kHidden}), g_b2({kClasses}), g_label({kN});
+
+  auto randv = [&](size_t n, float scale) {
+    std::vector<float> v(n);
+    for (auto &x : v) x = (U(gen) - 0.5f) * 2.f * scale;
+    return v;
+  };
+  std::vector<float> w1 = randv(kHidden * kIn, 0.5f),
+                     b1(kHidden, 0.f),
+                     w2 = randv(kClasses * kHidden, 0.5f),
+                     b2(kClasses, 0.f);
+  a_data.CopyFrom(xs);
+  a_label.CopyFrom(ys);
+  a_w1.CopyFrom(w1); a_b1.CopyFrom(b1);
+  a_w2.CopyFrom(w2); a_b2.CopyFrom(b2);
+
+  // arg order from ListArguments: data fc1_weight fc1_bias fc2_weight
+  // fc2_bias label
+  Executor exec(net, 1, 0,
+                {&a_data, &a_w1, &a_b1, &a_w2, &a_b2, &a_label},
+                {&g_data, &g_w1, &g_b1, &g_w2, &g_b2, &g_label});
+
+  const float lr = 0.5f;
+  float first_acc = -1.f, acc = 0.f;
+  auto sgd = [&](NDArray &p, NDArray &g, std::vector<float> &host, size_t n) {
+    auto grad = g.CopyTo(n);
+    for (size_t i = 0; i < n; i++) host[i] -= lr * grad[i] / kN;
+    p.CopyFrom(host);
+  };
+  for (int step = 0; step < 150; step++) {
+    exec.Forward(true);
+    exec.Backward();
+    auto outs = exec.Outputs();
+    auto probs = NDArray::CopyHandle(outs[0], kN * kClasses);
+    int correct = 0;
+    for (int i = 0; i < kN; i++) {
+      int pred = probs[i * kClasses + 1] > probs[i * kClasses] ? 1 : 0;
+      if (pred == static_cast<int>(ys[i])) correct++;
+    }
+    acc = static_cast<float>(correct) / kN;
+    if (step == 0) first_acc = acc;
+    sgd(a_w1, g_w1, w1, w1.size());
+    sgd(a_b1, g_b1, b1, b1.size());
+    sgd(a_w2, g_w2, w2, w2.size());
+    sgd(a_b2, g_b2, b2, b2.size());
+  }
+  std::printf("accuracy %.3f -> %.3f\n", first_acc, acc);
+  if (acc < 0.95f) {
+    std::fprintf(stderr, "cpp training failed to converge\n");
+    return 1;
+  }
+  std::printf("CPP SMOKE PASS\n");
+  return 0;
+}

@@ -1,0 +1,205 @@
+/*
+ * mxnet-cpp: header-only C++ API over the compiled C ABI (lib/libmxnet_tpu.so).
+ *
+ * The reference ships a header-only cpp-package generated over c_api.h
+ * (ref: cpp-package/include/mxnet-cpp/*.hpp, SURVEY.md §2.7). This is the
+ * TPU-native equivalent: RAII wrappers for NDArray / Symbol / Executor /
+ * KVStore over src/capi/libmxnet_tpu.c. Exceptions carry MXGetLastError.
+ *
+ * Example: cpp-package/example/train_mlp.cpp (built by src/capi/Makefile
+ * conventions: link -lmxnet_tpu).
+ */
+#ifndef MXNET_TPU_CPP_HPP_
+#define MXNET_TPU_CPP_HPP_
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef uint64_t MXTHandle;
+const char *MXGetLastError(void);
+int MXGetVersion(int *);
+int MXNDArrayCreate(const uint32_t *, uint32_t, int, int, int, MXTHandle *);
+int MXNDArrayFree(MXTHandle);
+int MXNDArraySyncCopyFromCPU(MXTHandle, const void *, size_t);
+int MXNDArraySyncCopyToCPU(MXTHandle, void *, size_t);
+int MXNDArrayGetShape(MXTHandle, uint32_t *, const uint32_t **);
+int MXNDArrayWaitAll(void);
+int MXSymbolCreateVariable(const char *, MXTHandle *);
+int MXSymbolCreateAtomicSymbol(const char *, uint32_t, const char **,
+                               const char **, MXTHandle *);
+int MXSymbolCompose(MXTHandle, const char *, uint32_t, const char **,
+                    MXTHandle *);
+int MXSymbolSaveToJSON(MXTHandle, const char **);
+int MXSymbolCreateFromJSON(const char *, MXTHandle *);
+int MXSymbolListArguments(MXTHandle, uint32_t *, const char ***);
+int MXExecutorBind(MXTHandle, int, int, uint32_t, MXTHandle *, MXTHandle *,
+                   uint32_t, MXTHandle *, MXTHandle *);
+int MXExecutorForward(MXTHandle, int);
+int MXExecutorBackward(MXTHandle, uint32_t, MXTHandle *);
+int MXExecutorOutputs(MXTHandle, uint32_t *, MXTHandle **);
+int MXKVStoreCreate(const char *, MXTHandle *);
+int MXKVStoreInit(MXTHandle, uint32_t, const int *, MXTHandle *);
+int MXKVStorePush(MXTHandle, uint32_t, const int *, MXTHandle *);
+int MXKVStorePull(MXTHandle, uint32_t, const int *, MXTHandle *);
+}
+
+namespace mxnet_tpu {
+
+#define MXTPU_CHECK(call)                                        \
+  do {                                                           \
+    if ((call) != 0) throw std::runtime_error(MXGetLastError()); \
+  } while (0)
+
+inline int GetVersion() {
+  int v = 0;
+  MXTPU_CHECK(MXGetVersion(&v));
+  return v;
+}
+
+class NDArray {
+ public:
+  explicit NDArray(const std::vector<uint32_t> &shape, int dev_type = 1,
+                   int dev_id = 0) {
+    MXTPU_CHECK(MXNDArrayCreate(shape.data(),
+                                static_cast<uint32_t>(shape.size()),
+                                dev_type, dev_id, 0, &handle_));
+  }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&o) noexcept : handle_(o.handle_) { o.handle_ = 0; }
+  ~NDArray() {
+    if (handle_) MXNDArrayFree(handle_);
+  }
+
+  void CopyFrom(const std::vector<float> &data) {
+    MXTPU_CHECK(MXNDArraySyncCopyFromCPU(handle_, data.data(), data.size()));
+  }
+  std::vector<float> CopyTo(size_t size) const {
+    std::vector<float> out(size);
+    MXTPU_CHECK(MXNDArraySyncCopyToCPU(handle_, out.data(), size));
+    return out;
+  }
+  static std::vector<float> CopyHandle(MXTHandle h, size_t size) {
+    std::vector<float> out(size);
+    MXTPU_CHECK(MXNDArraySyncCopyToCPU(h, out.data(), size));
+    return out;
+  }
+  std::vector<uint32_t> Shape() const {
+    uint32_t ndim = 0;
+    const uint32_t *dims = nullptr;
+    MXTPU_CHECK(MXNDArrayGetShape(handle_, &ndim, &dims));
+    return std::vector<uint32_t>(dims, dims + ndim);
+  }
+  MXTHandle handle() const { return handle_; }
+
+ private:
+  MXTHandle handle_ = 0;
+};
+
+class Symbol {
+ public:
+  static Symbol Variable(const std::string &name) {
+    MXTHandle h = 0;
+    MXTPU_CHECK(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  /* Atomic op + composition in one call, mirroring Operator().CreateSymbol */
+  static Symbol Create(const std::string &op,
+                       const std::map<std::string, std::string> &params,
+                       const std::string &name,
+                       const std::vector<std::string> &arg_names,
+                       const std::vector<Symbol *> &args) {
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    MXTHandle h = 0;
+    MXTPU_CHECK(MXSymbolCreateAtomicSymbol(
+        op.c_str(), static_cast<uint32_t>(keys.size()), keys.data(),
+        vals.data(), &h));
+    std::vector<const char *> anames;
+    std::vector<MXTHandle> ahandles;
+    for (size_t i = 0; i < args.size(); i++) {
+      anames.push_back(arg_names[i].c_str());
+      ahandles.push_back(args[i]->handle());
+    }
+    MXTPU_CHECK(MXSymbolCompose(h, name.c_str(),
+                                static_cast<uint32_t>(ahandles.size()),
+                                anames.data(), ahandles.data()));
+    return Symbol(h);
+  }
+  std::vector<std::string> ListArguments() const {
+    uint32_t n = 0;
+    const char **names = nullptr;
+    MXTPU_CHECK(MXSymbolListArguments(handle_, &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  std::string ToJSON() const {
+    const char *s = nullptr;
+    MXTPU_CHECK(MXSymbolSaveToJSON(handle_, &s));
+    return std::string(s);
+  }
+  MXTHandle handle() const { return handle_; }
+
+ private:
+  explicit Symbol(MXTHandle h) : handle_(h) {}
+  MXTHandle handle_;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol &sym, int dev_type, int dev_id,
+           const std::vector<NDArray *> &args,
+           const std::vector<NDArray *> &grads) {
+    std::vector<MXTHandle> ah, gh;
+    for (auto *a : args) ah.push_back(a->handle());
+    for (auto *g : grads) gh.push_back(g->handle());
+    MXTPU_CHECK(MXExecutorBind(sym.handle(), dev_type, dev_id,
+                               static_cast<uint32_t>(ah.size()), ah.data(),
+                               gh.empty() ? nullptr : gh.data(), 0, nullptr,
+                               &handle_));
+  }
+  void Forward(bool is_train) {
+    MXTPU_CHECK(MXExecutorForward(handle_, is_train ? 1 : 0));
+  }
+  void Backward() { MXTPU_CHECK(MXExecutorBackward(handle_, 0, nullptr)); }
+  std::vector<MXTHandle> Outputs() const {
+    uint32_t n = 0;
+    MXTHandle *outs = nullptr;
+    MXTPU_CHECK(MXExecutorOutputs(handle_, &n, &outs));
+    return std::vector<MXTHandle>(outs, outs + n);
+  }
+
+ private:
+  MXTHandle handle_;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    MXTPU_CHECK(MXKVStoreCreate(type.c_str(), &handle_));
+  }
+  void Init(int key, const NDArray &v) {
+    MXTHandle h = v.handle();
+    MXTPU_CHECK(MXKVStoreInit(handle_, 1, &key, &h));
+  }
+  void Push(int key, const NDArray &v) {
+    MXTHandle h = v.handle();
+    MXTPU_CHECK(MXKVStorePush(handle_, 1, &key, &h));
+  }
+  void Pull(int key, NDArray *v) {
+    MXTHandle h = v->handle();
+    MXTPU_CHECK(MXKVStorePull(handle_, 1, &key, &h));
+  }
+
+ private:
+  MXTHandle handle_;
+};
+
+}  // namespace mxnet_tpu
+#endif  // MXNET_TPU_CPP_HPP_

@@ -31,6 +31,7 @@ from . import kvstore as kv
 from . import callback
 from . import module
 from . import module as mod
+from . import executor_manager
 from . import monitor
 from .monitor import Monitor
 from . import model

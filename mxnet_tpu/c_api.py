@@ -345,3 +345,23 @@ def MXKVStoreFree(handle):
 @_capi
 def MXKVStoreGetNumDeadNode(handle, node_id, timeout_sec=60):
     return _get(handle).num_dead_node(node_id, timeout_sec)
+
+
+# ---------------------------------------------------------------------------
+# byte-level marshalling helpers for the compiled shim (src/capi/): the C
+# side traffics raw buffers; dtype framing happens here
+# ---------------------------------------------------------------------------
+@_capi
+def MXNDArraySyncCopyFromBytes(handle, buf, dtype="float32"):
+    a = _get(handle)
+    a[:] = np.frombuffer(buf, np.dtype(dtype)).reshape(a.shape)
+
+
+@_capi
+def MXNDArraySyncCopyToBytes(handle):
+    return np.ascontiguousarray(_get(handle).asnumpy()).tobytes()
+
+
+@_capi
+def MXNDArraySize(handle):
+    return int(_get(handle).size)

@@ -284,7 +284,10 @@ class Module(BaseModule):
         for l in (label_shapes or []):
             name, shape = (l.name, l.shape) if hasattr(l, "name") else (l[0], l[1])
             shapes[name] = shape
-        self._exec_group.executor = self._exec_group.executor.reshape(**shapes)
+        # allow_up_sizing: Module.reshape serves batch-size changes in both
+        # directions (ref executor_group passes it on this path)
+        self._exec_group.executor = self._exec_group.executor.reshape(
+            allow_up_sizing=True, **shapes)
 
     # -- optimizer ------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",

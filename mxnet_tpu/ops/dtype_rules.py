@@ -67,7 +67,10 @@ def _where_type(attrs, ins):
 def install():
     _set("Cast", _cast_type)
     _set("one_hot", _attr_dtype_out)
-    for s in ("_sample_uniform", "_sample_normal", "_sample_gamma",
+    for s in ("_random_uniform", "_random_normal", "_random_gamma",
+              "_random_exponential", "_random_poisson",
+              "_random_negative_binomial",
+              "_sample_uniform", "_sample_normal", "_sample_gamma",
               "_sample_exponential", "_sample_poisson",
               "_sample_negbinomial"):
         _set(s, _attr_dtype_out)

@@ -98,11 +98,13 @@ def _conv_layout(attrs, nd):
     the TPU-preferred layout that also makes a 1x1 conv a free reshape to a
     matmul (the Pallas conv+BN-stats fusion requires it). Weights stay OIHW
     in every layout so checkpoints transfer."""
+    default = "NCHW" if nd == 2 else ("NCW" if nd == 1 else "NCDHW")
     layout = attr_str(attrs.get("layout", ""), "")
-    if not layout:
-        return "NCHW" if nd == 2 else ("NCW" if nd == 1 else "NCDHW")
-    if layout not in ("NCHW", "NHWC") or nd != 2:
-        raise MXNetError("Convolution: unsupported layout %r" % layout)
+    if not layout or layout == default:
+        return default
+    if nd != 2 or layout != "NHWC":
+        raise MXNetError("Convolution: unsupported layout %r for %d-d"
+                         % (layout, nd))
     return layout
 
 
@@ -443,7 +445,7 @@ def _pool_out_dim(in_dim, k, s, p, convention):
 
 def _pool_nhwc(attrs):
     layout = attr_str(attrs.get("layout", ""), "")
-    if layout and layout not in ("NCHW", "NHWC"):
+    if layout and layout not in ("NCHW", "NHWC", "NCW", "NCDHW"):
         raise MXNetError("Pooling: unsupported layout %r" % layout)
     return layout == "NHWC"
 

@@ -546,48 +546,48 @@ def _register_sample(name, draw, aliases=()):
 
 
 _register_sample(
-    "_sample_uniform",
+    "_random_uniform",
     lambda key, attrs, shape, dt: jax.random.uniform(
         key, shape, dtype=dt,
         minval=attr_float(attrs.get("low", 0.0), 0.0),
         maxval=attr_float(attrs.get("high", 1.0), 1.0)),
-    aliases=("uniform", "random_uniform", "_random_uniform"))
+    aliases=("uniform", "random_uniform"))
 
 _register_sample(
-    "_sample_normal",
+    "_random_normal",
     lambda key, attrs, shape, dt: (
         attr_float(attrs.get("loc", 0.0), 0.0)
         + attr_float(attrs.get("scale", 1.0), 1.0)
         * jax.random.normal(key, shape, dtype=dt)),
-    aliases=("normal", "random_normal", "_random_normal"))
+    aliases=("normal", "random_normal"))
 
 _register_sample(
-    "_sample_gamma",
+    "_random_gamma",
     lambda key, attrs, shape, dt: (
         jax.random.gamma(key, attr_float(attrs.get("alpha", 1.0), 1.0),
                          shape, dtype=dt)
         * attr_float(attrs.get("beta", 1.0), 1.0)),
-    aliases=("_random_gamma",))
+    )
 
 _register_sample(
-    "_sample_exponential",
+    "_random_exponential",
     lambda key, attrs, shape, dt: (
         jax.random.exponential(key, shape, dtype=dt)
         / attr_float(attrs.get("lam", 1.0), 1.0)),
-    aliases=("_random_exponential",))
+    )
 
 _register_sample(
-    "_sample_poisson",
+    "_random_poisson",
     lambda key, attrs, shape, dt: jax.random.poisson(
         key, attr_float(attrs.get("lam", 1.0), 1.0), shape).astype(dt),
-    aliases=("_random_poisson",))
+    )
 
 _register_sample(
-    "_sample_negbinomial",
+    "_random_negative_binomial",
     lambda key, attrs, shape, dt: _neg_binomial(
         key, attr_int(attrs.get("k", 1), 1),
         attr_float(attrs.get("p", 1.0), 1.0), shape).astype(dt),
-    aliases=("_random_negative_binomial",))
+    )
 
 
 def _neg_binomial(key, k, p, shape):
@@ -595,3 +595,60 @@ def _neg_binomial(key, k, p, shape):
     k1, k2 = jax.random.split(key)
     lam = jax.random.gamma(k1, k, shape) * ((1.0 - p) / p)
     return jax.random.poisson(k2, lam, shape)
+
+
+# ---------------------------------------------------------------------------
+# tensor-parameter multisampling (ref: tensor/multisample_op.cc): each entry
+# of the parameter array(s) draws its own `shape`-shaped sample block;
+# output shape = param.shape + shape
+# ---------------------------------------------------------------------------
+
+def _register_multisample(name, n_params, draw):
+    def infer(attrs, in_shapes):
+        p0 = in_shapes[0]
+        if p0 is None:
+            raise MXNetError("%s: parameter shape required" % name)
+        tail = attr_tuple(attrs.get("shape", ()), ())
+        return [tuple(p0)] * n_params, [tuple(p0) + tuple(tail)], []
+
+    def fn(op_ctx, attrs, inputs, aux):
+        if op_ctx.rng is None:
+            raise MXNetError("op %s requires a PRNG key" % name)
+        if len(inputs) != n_params:
+            raise MXNetError("%s takes %d parameter array(s), got %d"
+                             % (name, n_params, len(inputs)))
+        tail = attr_tuple(attrs.get("shape", ()), ())
+        dt = jnp.dtype(str(attrs.get("dtype", "float32")))
+        pshape = inputs[0].shape
+        flat = [jnp.ravel(p.astype(jnp.float32)) for p in inputs]
+        n = flat[0].shape[0] if flat[0].ndim else 1
+        keys = jax.random.split(op_ctx.rng, max(n, 1))
+        out = jax.vmap(lambda k, *ps: draw(k, ps, tuple(tail)))(keys, *flat)
+        return (out.reshape(tuple(pshape) + tuple(tail)).astype(dt),)
+
+    inputs = ("low", "high")[:n_params] if "uniform" in name else \
+        ("mu", "sigma")[:n_params] if "normal" in name else \
+        ("alpha", "beta")[:n_params] if "gamma" in name else \
+        ("k", "p")[:n_params] if "negbinomial" in name else ("lam",)
+    register_def(OpDef(name, fn, inputs=inputs, needs_rng=True,
+                       infer_shape=infer))
+
+
+_register_multisample(
+    "_sample_uniform", 2,
+    lambda k, ps, sh: jax.random.uniform(k, sh) * (ps[1] - ps[0]) + ps[0])
+_register_multisample(
+    "_sample_normal", 2,
+    lambda k, ps, sh: ps[0] + ps[1] * jax.random.normal(k, sh))
+_register_multisample(
+    "_sample_gamma", 2,
+    lambda k, ps, sh: jax.random.gamma(k, ps[0], sh) * ps[1])
+_register_multisample(
+    "_sample_exponential", 1,
+    lambda k, ps, sh: jax.random.exponential(k, sh) / ps[0])
+_register_multisample(
+    "_sample_poisson", 1,
+    lambda k, ps, sh: jax.random.poisson(k, ps[0], sh).astype(jnp.float32))
+_register_multisample(
+    "_sample_negbinomial", 2,
+    lambda k, ps, sh: _neg_binomial(k, ps[0], ps[1], sh).astype(jnp.float32))

@@ -66,8 +66,9 @@ def np_rng():
 
 
 # ---------------------------------------------------------------------------
-# sampling API (ref: python/mxnet/random.py uniform/normal/...; the sample ops
-# themselves live in ops/tensor.py as _sample_*)
+# sampling API (ref: python/mxnet/random.py uniform/normal/...; scalar ops
+# are _random_* in ops/tensor.py; the tensor-parameter _sample_* multisample
+# family (ref multisample_op.cc) is exposed via nd._sample_*)
 # ---------------------------------------------------------------------------
 
 def _sample(op_name, out=None, **attrs):
@@ -77,28 +78,28 @@ def _sample(op_name, out=None, **attrs):
 
 
 def uniform(low=0, high=1, shape=None, ctx=None, out=None):
-    return _sample("_sample_uniform", out=out, low=low, high=high,
+    return _sample("_random_uniform", out=out, low=low, high=high,
                    shape=shape or (1,))
 
 
 def normal(loc=0, scale=1, shape=None, ctx=None, out=None):
-    return _sample("_sample_normal", out=out, loc=loc, scale=scale,
+    return _sample("_random_normal", out=out, loc=loc, scale=scale,
                    shape=shape or (1,))
 
 
 def gamma(alpha=1, beta=1, shape=None, ctx=None, out=None):
-    return _sample("_sample_gamma", out=out, alpha=alpha, beta=beta,
+    return _sample("_random_gamma", out=out, alpha=alpha, beta=beta,
                    shape=shape or (1,))
 
 
 def exponential(lam=1, shape=None, ctx=None, out=None):
-    return _sample("_sample_exponential", out=out, lam=lam, shape=shape or (1,))
+    return _sample("_random_exponential", out=out, lam=lam, shape=shape or (1,))
 
 
 def poisson(lam=1, shape=None, ctx=None, out=None):
-    return _sample("_sample_poisson", out=out, lam=lam, shape=shape or (1,))
+    return _sample("_random_poisson", out=out, lam=lam, shape=shape or (1,))
 
 
 def negative_binomial(k=1, p=1, shape=None, ctx=None, out=None):
-    return _sample("_sample_negbinomial", out=out, k=k, p=p,
+    return _sample("_random_negative_binomial", out=out, k=k, p=p,
                    shape=shape or (1,))

@@ -1,0 +1,479 @@
+/*
+ * libmxnet_tpu — compiled C API over the Python substrate.
+ *
+ * Reproduces the reference's binding contract (ref:
+ * include/mxnet/c_api.h, src/c_api/*.cc: opaque handles, int status
+ * returns, MXGetLastError) as real `extern "C"` symbols a non-Python
+ * client can link (cpp-package/R/Scala-style consumers, SURVEY.md §2.7).
+ * Each entry point marshals into mxnet_tpu.c_api via the embedded CPython
+ * interpreter; handles are the Python-side integer registry keys.
+ *
+ * Build: make -C src/capi     (links libpython via python3-config --embed)
+ * Smoke client: src/capi/smoke_client.c trains a layer through this ABI.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+typedef uint64_t NDArrayHandle;
+typedef uint64_t SymbolHandle;
+typedef uint64_t ExecutorHandle;
+typedef uint64_t KVStoreHandle;
+
+#define MXTPU_EXPORT __attribute__((visibility("default")))
+
+static PyObject *g_capi = NULL;          /* mxnet_tpu.c_api module */
+static __thread char g_err[4096];
+static __thread char g_shape_buf[32 * sizeof(uint32_t)];
+
+static void set_err(const char *msg) {
+    strncpy(g_err, msg ? msg : "unknown error", sizeof(g_err) - 1);
+    g_err[sizeof(g_err) - 1] = 0;
+}
+
+static void set_err_from_py(void) {
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    if (v) {
+        PyObject *s = PyObject_Str(v);
+        set_err(s ? PyUnicode_AsUTF8(s) : "python error");
+        Py_XDECREF(s);
+    } else {
+        set_err("python error (no message)");
+    }
+    Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+}
+
+/* Initialize the interpreter + import mxnet_tpu.c_api once. */
+static int ensure_init(void) {
+    if (g_capi) return 0;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        /* release the GIL so PyGILState_Ensure works from any thread */
+        PyEval_SaveThread();
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    if (!g_capi) {
+        PyObject *m = PyImport_ImportModule("mxnet_tpu.c_api");
+        if (!m) { set_err_from_py(); PyGILState_Release(st); return -1; }
+        g_capi = m;                       /* keep the reference forever */
+    }
+    PyGILState_Release(st);
+    return 0;
+}
+
+/* Call c_api.<name>(*args); unpack the (status, value) tuple.
+ * Returns new ref to value or NULL (error stored). */
+static PyObject *capi_call(const char *name, PyObject *args) {
+    PyObject *fn = PyObject_GetAttrString(g_capi, name);
+    if (!fn) { set_err_from_py(); Py_XDECREF(args); return NULL; }
+    PyObject *res = PyObject_CallObject(fn, args);
+    Py_DECREF(fn);
+    Py_XDECREF(args);
+    if (!res) { set_err_from_py(); return NULL; }
+    if (!PyTuple_Check(res) || PyTuple_Size(res) != 2) {
+        set_err("c_api returned malformed result");
+        Py_DECREF(res);
+        return NULL;
+    }
+    long status = PyLong_AsLong(PyTuple_GetItem(res, 0));
+    if (status != 0) {
+        PyObject *le = PyObject_CallMethod(g_capi, "MXGetLastError", NULL);
+        if (le) {
+            PyObject *msg = PyTuple_Check(le) && PyTuple_Size(le) == 2
+                                ? PyTuple_GetItem(le, 1) : le;
+            if (msg && PyUnicode_Check(msg)) set_err(PyUnicode_AsUTF8(msg));
+            else set_err("c_api call failed");
+            Py_DECREF(le);
+        } else {
+            PyErr_Clear();
+            set_err("c_api call failed");
+        }
+        Py_DECREF(res);
+        return NULL;
+    }
+    PyObject *val = PyTuple_GetItem(res, 1);
+    Py_INCREF(val);
+    Py_DECREF(res);
+    return val;
+}
+
+#define ENSURE() do { if (ensure_init()) return -1; } while (0)
+
+MXTPU_EXPORT const char *MXGetLastError(void) { return g_err; }
+
+MXTPU_EXPORT int MXGetVersion(int *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXGetVersion", PyTuple_New(0));
+    int rc = -1;
+    if (v) { *out = (int)PyLong_AsLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXNotifyShutdown(void) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXNotifyShutdown", PyTuple_New(0));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ---------------- NDArray ---------------- */
+
+MXTPU_EXPORT int MXNDArrayCreate(const uint32_t *shape, uint32_t ndim,
+                                 int dev_type, int dev_id, int delay_alloc,
+                                 NDArrayHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pshape = PyTuple_New(ndim);
+    for (uint32_t i = 0; i < ndim; i++)
+        PyTuple_SetItem(pshape, i, PyLong_FromUnsignedLong(shape[i]));
+    PyObject *v = capi_call("MXNDArrayCreate",
+                            Py_BuildValue("(Niii)", pshape, dev_type,
+                                          dev_id, delay_alloc));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXNDArrayFree(NDArrayHandle h) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXNDArrayFree", Py_BuildValue("(K)", h));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                                          size_t size) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *buf = PyBytes_FromStringAndSize((const char *)data,
+                                              size * sizeof(float));
+    PyObject *v = capi_call("MXNDArraySyncCopyFromBytes",
+                            Py_BuildValue("(KN)", h, buf));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data,
+                                        size_t size) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXNDArraySyncCopyToBytes",
+                            Py_BuildValue("(K)", h));
+    int rc = -1;
+    if (v) {
+        Py_ssize_t n = PyBytes_Size(v);
+        size_t want = size * sizeof(float);
+        if ((size_t)n < want) want = (size_t)n;
+        memcpy(data, PyBytes_AsString(v), want);
+        Py_DECREF(v);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXNDArrayGetShape(NDArrayHandle h, uint32_t *out_dim,
+                                   const uint32_t **out_pdata) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXNDArrayGetShape", Py_BuildValue("(K)", h));
+    int rc = -1;
+    if (v) {
+        uint32_t n = (uint32_t)PySequence_Size(v);
+        uint32_t *buf = (uint32_t *)g_shape_buf;
+        for (uint32_t i = 0; i < n && i < 32; i++) {
+            PyObject *it = PySequence_GetItem(v, i);
+            buf[i] = (uint32_t)PyLong_AsUnsignedLong(it);
+            Py_DECREF(it);
+        }
+        *out_dim = n;
+        *out_pdata = buf;
+        Py_DECREF(v);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXNDArrayWaitAll(void) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXNDArrayWaitAll", PyTuple_New(0));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ---------------- Symbol ---------------- */
+
+MXTPU_EXPORT int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXSymbolCreateVariable",
+                            Py_BuildValue("(s)", name));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXSymbolCreateAtomicSymbol(const char *op_name,
+                                            uint32_t num_param,
+                                            const char **keys,
+                                            const char **vals,
+                                            SymbolHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pk = PyList_New(num_param), *pv = PyList_New(num_param);
+    for (uint32_t i = 0; i < num_param; i++) {
+        PyList_SetItem(pk, i, PyUnicode_FromString(keys[i]));
+        PyList_SetItem(pv, i, PyUnicode_FromString(vals[i]));
+    }
+    PyObject *v = capi_call("MXSymbolCreateAtomicSymbol",
+                            Py_BuildValue("(sNN)", op_name, pk, pv));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXSymbolCompose(SymbolHandle sym, const char *name,
+                                 uint32_t num_args, const char **keys,
+                                 SymbolHandle *args) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pa = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; i++)
+        PyList_SetItem(pa, i, PyLong_FromUnsignedLongLong(args[i]));
+    PyObject *pk;
+    if (keys) {
+        pk = PyList_New(num_args);
+        for (uint32_t i = 0; i < num_args; i++)
+            PyList_SetItem(pk, i, PyUnicode_FromString(keys[i]));
+    } else {
+        pk = Py_None;
+        Py_INCREF(Py_None);
+    }
+    PyObject *v = capi_call("MXSymbolCompose",
+                            Py_BuildValue("(KsNN)", sym, name, pa, pk));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXSymbolSaveToJSON(SymbolHandle sym, const char **out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    static __thread char *json_buf = NULL;
+    PyObject *v = capi_call("MXSymbolSaveToJSON", Py_BuildValue("(K)", sym));
+    int rc = -1;
+    if (v) {
+        const char *s = PyUnicode_AsUTF8(v);
+        free(json_buf);
+        json_buf = strdup(s ? s : "");
+        *out = json_buf;
+        Py_DECREF(v);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXSymbolCreateFromJSON",
+                            Py_BuildValue("(s)", json));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* list arguments: returns count; names via repeated calls (thread buffer) */
+MXTPU_EXPORT int MXSymbolListArguments(SymbolHandle sym, uint32_t *out_size,
+                                       const char ***out_array) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    static __thread char **name_buf = NULL;
+    static __thread uint32_t name_cnt = 0;
+    PyObject *v = capi_call("MXSymbolListArguments",
+                            Py_BuildValue("(K)", sym));
+    int rc = -1;
+    if (v) {
+        for (uint32_t i = 0; i < name_cnt; i++) free(name_buf[i]);
+        free(name_buf);
+        name_cnt = (uint32_t)PySequence_Size(v);
+        name_buf = (char **)calloc(name_cnt, sizeof(char *));
+        for (uint32_t i = 0; i < name_cnt; i++) {
+            PyObject *it = PySequence_GetItem(v, i);
+            name_buf[i] = strdup(PyUnicode_AsUTF8(it));
+            Py_DECREF(it);
+        }
+        *out_size = name_cnt;
+        *out_array = (const char **)name_buf;
+        Py_DECREF(v);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ---------------- Executor ---------------- */
+
+MXTPU_EXPORT int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                                uint32_t num_args, NDArrayHandle *in_args,
+                                NDArrayHandle *arg_grads,
+                                uint32_t num_aux, NDArrayHandle *aux_states,
+                                ExecutorHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pargs = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; i++)
+        PyList_SetItem(pargs, i, PyLong_FromUnsignedLongLong(in_args[i]));
+    PyObject *pgrads;
+    if (arg_grads) {
+        pgrads = PyList_New(num_args);
+        for (uint32_t i = 0; i < num_args; i++)
+            PyList_SetItem(pgrads, i,
+                           PyLong_FromUnsignedLongLong(arg_grads[i]));
+    } else {
+        pgrads = Py_None;
+        Py_INCREF(Py_None);
+    }
+    PyObject *paux;
+    if (num_aux) {
+        paux = PyList_New(num_aux);
+        for (uint32_t i = 0; i < num_aux; i++)
+            PyList_SetItem(paux, i,
+                           PyLong_FromUnsignedLongLong(aux_states[i]));
+    } else {
+        paux = Py_None;
+        Py_INCREF(Py_None);
+    }
+    PyObject *v = capi_call("MXExecutorBind",
+                            Py_BuildValue("(KiiNNsN)", sym, dev_type, dev_id,
+                                          pargs, pgrads, "write", paux));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXExecutorForward(ExecutorHandle h, int is_train) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXExecutorForward",
+                            Py_BuildValue("(Ki)", h, is_train));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXExecutorBackward(ExecutorHandle h, uint32_t len,
+                                    NDArrayHandle *head_grads) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pg;
+    if (len && head_grads) {
+        pg = PyList_New(len);
+        for (uint32_t i = 0; i < len; i++)
+            PyList_SetItem(pg, i, PyLong_FromUnsignedLongLong(head_grads[i]));
+    } else {
+        pg = Py_None;
+        Py_INCREF(Py_None);
+    }
+    PyObject *v = capi_call("MXExecutorBackward",
+                            Py_BuildValue("(KN)", h, pg));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXExecutorOutputs(ExecutorHandle h, uint32_t *out_size,
+                                   NDArrayHandle **out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    static __thread NDArrayHandle *out_buf = NULL;
+    PyObject *v = capi_call("MXExecutorOutputs", Py_BuildValue("(K)", h));
+    int rc = -1;
+    if (v) {
+        uint32_t n = (uint32_t)PySequence_Size(v);
+        free(out_buf);
+        out_buf = (NDArrayHandle *)calloc(n, sizeof(NDArrayHandle));
+        for (uint32_t i = 0; i < n; i++) {
+            PyObject *it = PySequence_GetItem(v, i);
+            out_buf[i] = PyLong_AsUnsignedLongLong(it);
+            Py_DECREF(it);
+        }
+        *out_size = n;
+        *out = out_buf;
+        Py_DECREF(v);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ---------------- KVStore ---------------- */
+
+MXTPU_EXPORT int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXKVStoreCreate", Py_BuildValue("(s)", type));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+static int kv_keyvals(const char *fname, KVStoreHandle h, uint32_t num,
+                      const int *keys, NDArrayHandle *vals) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pk = PyList_New(num), *pv = PyList_New(num);
+    for (uint32_t i = 0; i < num; i++) {
+        PyList_SetItem(pk, i, PyLong_FromLong(keys[i]));
+        PyList_SetItem(pv, i, PyLong_FromUnsignedLongLong(vals[i]));
+    }
+    PyObject *v = capi_call(fname, Py_BuildValue("(KNN)", h, pk, pv));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXKVStoreInit(KVStoreHandle h, uint32_t num,
+                               const int *keys, NDArrayHandle *vals) {
+    ENSURE();
+    return kv_keyvals("MXKVStoreInit", h, num, keys, vals);
+}
+
+MXTPU_EXPORT int MXKVStorePush(KVStoreHandle h, uint32_t num,
+                               const int *keys, NDArrayHandle *vals) {
+    ENSURE();
+    return kv_keyvals("MXKVStorePush", h, num, keys, vals);
+}
+
+MXTPU_EXPORT int MXKVStorePull(KVStoreHandle h, uint32_t num,
+                               const int *keys, NDArrayHandle *vals) {
+    ENSURE();
+    return kv_keyvals("MXKVStorePull", h, num, keys, vals);
+}

@@ -1,0 +1,92 @@
+"""cpp-package smoke (C++ client over the compiled ABI) and the legacy
+executor_manager API (ref: cpp-package/example/mlp.cpp,
+python/mxnet/executor_manager.py)."""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_manager as em
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.mark.skipif(shutil.which("c++") is None, reason="no C++ toolchain")
+def test_cpp_package_trains():
+    lib = os.path.join(ROOT, "lib", "libmxnet_tpu.so")
+    if not os.path.exists(lib):
+        r = subprocess.run(["make", "-C", os.path.join(ROOT, "src", "capi")],
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+    binp = os.path.join(ROOT, "lib", "train_mlp_cpp")
+    src = os.path.join(ROOT, "cpp-package", "example", "train_mlp.cpp")
+    if (not os.path.exists(binp)
+            or os.path.getmtime(src) > os.path.getmtime(binp)):
+        r = subprocess.run(
+            ["c++", "-O2", "-std=c++14",
+             "-I", os.path.join(ROOT, "cpp-package", "include"),
+             src, "-L", os.path.join(ROOT, "lib"), "-lmxnet_tpu",
+             "-Wl,-rpath,$ORIGIN", "-o", binp],
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([binp], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CPP SMOKE PASS" in r.stdout
+
+
+def test_split_input_slice():
+    s = em._split_input_slice(10, [1, 1, 2])
+    assert s == [slice(0, 2), slice(2, 4), slice(4, 10)]
+    assert em._split_input_slice(4, [1]) == [slice(0, 4)]
+    with pytest.raises(ValueError):
+        em._split_input_slice(2, [1, 1, 1])
+
+
+def test_executor_manager_train_step():
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"),
+        name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 6).astype(np.float32)
+    Y = rng.randint(0, 4, 8).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8)
+    m = em.DataParallelExecutorManager(net, mx.cpu(), it)
+    shapes, _, _ = net.infer_shape(data=(8, 6), softmax_label=(8,))
+    init = mx.initializer.Xavier()
+    arg_params = {}
+    for n, s in zip(net.list_arguments(), shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        a = mx.nd.zeros(s)
+        init(mx.initializer.InitDesc(n), a)
+        arg_params[n] = a
+    m.set_params(arg_params, {})
+    b = it.next()
+    m.load_data_batch(b)
+    m.forward(is_train=True)
+    m.backward()
+    assert float(np.abs(m.grad_arrays[0].asnumpy()).sum()) > 0
+    metric = mx.metric.Accuracy()
+    m.update_metric(metric, b.label)
+    out_a, out_x = {}, {}
+    m.copy_to(out_a, out_x)
+    assert set(out_a) == {"fc_weight", "fc_bias"}
+    assert m.param_arrays[0].shape == (4, 6)
+
+
+def test_executor_manager_rejects_bad_workload():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2)
+    it = mx.io.NDArrayIter(np.zeros((4, 3), np.float32),
+                           np.zeros(4, np.float32), batch_size=4)
+    with pytest.raises(mx.base.MXNetError):
+        em.DataParallelExecutorManager(net, mx.cpu(), it,
+                                       work_load_list=[1, 2])

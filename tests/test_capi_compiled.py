@@ -1,0 +1,51 @@
+"""Compiled C API: build libmxnet_tpu.so + run the pure-C smoke client
+that trains a layer through the ABI (ref: include/mxnet/c_api.h contract,
+cpp-package consumption; SURVEY.md §2.7 layer 11)."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+LIB = os.path.join(ROOT, "lib", "libmxnet_tpu.so")
+CLIENT = os.path.join(ROOT, "lib", "smoke_client")
+SRC = os.path.join(ROOT, "src", "capi")
+
+
+def _build():
+    r = subprocess.run(["make", "-C", SRC], capture_output=True, text=True,
+                       timeout=300)
+    return r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.skipif(shutil.which("cc") is None
+                    or shutil.which("python3-config") is None,
+                    reason="no C toolchain")
+def test_compiled_capi_smoke_client_trains():
+    src_newer = (not os.path.exists(LIB)
+                 or os.path.getmtime(os.path.join(SRC, "libmxnet_tpu.c"))
+                 > os.path.getmtime(LIB))
+    if src_newer or not os.path.exists(CLIENT):
+        ok, log = _build()
+        assert ok, "C API build failed:\n%s" % log
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([CLIENT], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, "smoke client failed:\nstdout:%s\nstderr:%s" \
+        % (r.stdout, r.stderr)
+    assert "SMOKE PASS" in r.stdout
+
+
+def test_exported_symbols_are_c_linkage():
+    if not os.path.exists(LIB):
+        pytest.skip("lib not built")
+    r = subprocess.run(["nm", "-D", LIB], capture_output=True, text=True)
+    syms = r.stdout
+    for s in ("MXGetLastError", "MXNDArrayCreate", "MXSymbolCompose",
+              "MXExecutorBind", "MXExecutorForward", "MXExecutorBackward",
+              "MXKVStorePush", "MXKVStorePull"):
+        assert " T %s" % s in syms or " T _%s" % s in syms, \
+            "symbol %s not exported" % s
