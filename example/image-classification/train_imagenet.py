@@ -74,22 +74,27 @@ def main():
     devs = (mx.current_context() if args.gpus is None
             else [mx.gpu(int(i)) for i in args.gpus.split(",")])
 
+    kvstore = mx.kv.create(args.kv_store)
     if args.synthetic or args.data_train is None:
         train = SyntheticIter(args.batch_size, image_shape, args.num_classes,
                               args.epoch_size)
         val = None
     else:
-        train = mx.image.ImageIter(
-            batch_size=args.batch_size, data_shape=image_shape,
-            path_imgrec=args.data_train, shuffle=True,
-            aug_list=mx.image.CreateAugmenter(
-                (args.batch_size,) + image_shape, rand_crop=True,
-                rand_mirror=True, mean=True, std=True))
-        val = None if args.data_val is None else mx.image.ImageIter(
-            batch_size=args.batch_size, data_shape=image_shape,
-            path_imgrec=args.data_val,
-            aug_list=mx.image.CreateAugmenter(
-                (args.batch_size,) + image_shape, mean=True, std=True))
+        # native fused decode/augment engine (src/io/image_decode.cc);
+        # part_index/num_parts shard the input across dist_sync workers
+        kv_tmp = kvstore
+        norm = dict(mean_r=123.68, mean_g=116.78, mean_b=103.94,
+                    std_r=58.395, std_g=57.12, std_b=57.375)
+        train = mx.image.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, resize=256,
+            part_index=kv_tmp.rank, num_parts=kv_tmp.num_workers, **norm)
+        # val sharded like train: each worker scores its slice
+        val = None if args.data_val is None else mx.image.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=image_shape,
+            batch_size=args.batch_size, resize=256,
+            part_index=kv_tmp.rank, num_parts=kv_tmp.num_workers, **norm)
 
     # epoch-boundary lr schedule (ref: fit.py _get_lr_scheduler)
     epoch_size = args.epoch_size
@@ -115,7 +120,7 @@ def main():
             optimizer="sgd",
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
                               "wd": args.wd, "lr_scheduler": lr_sched},
-            kvstore=args.kv_store,
+            kvstore=kvstore,
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
             epoch_end_callback=cb)
 

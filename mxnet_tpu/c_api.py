@@ -365,3 +365,60 @@ def MXNDArraySyncCopyToBytes(handle):
 @_capi
 def MXNDArraySize(handle):
     return int(_get(handle).size)
+
+
+# ---------------------------------------------------------------------------
+# C predict API (ref: include/mxnet/c_predict_api.h, src/c_api/
+# c_predict_api.cc — the deploy/amalgamation surface) over Predictor
+# ---------------------------------------------------------------------------
+@_capi
+def MXPredCreate(symbol_json, param_bytes, dev_type, dev_id,
+                 input_keys, input_shapes):
+    from . import dmlc_serial
+    from .predictor import Predictor
+    from .context import Context
+    ctx = Context(Context.devtype2str[dev_type], dev_id)
+    if param_bytes:
+        arrs, names = dmlc_serial.loads(bytes(param_bytes))
+        params = {n: NDArray(np.asarray(a)) for n, a in zip(names, arrs)}
+    else:
+        params = {}
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    pred = Predictor(symbol_json, params, shapes, ctx=ctx)
+    pred._pending = {}
+    return _new_handle(pred)
+
+
+@_capi
+def MXPredSetInput(handle, key, buf, dtype="float32"):
+    pred = _get(handle)
+    shape = None
+    for name in pred._input_names:
+        if name == key:
+            shape = pred._executor.arg_dict[name].shape
+    if shape is None:
+        raise MXNetError("MXPredSetInput: unknown input %r" % key)
+    pred._pending[key] = np.frombuffer(buf, np.dtype(dtype)).reshape(shape)
+
+
+@_capi
+def MXPredForward(handle):
+    pred = _get(handle)
+    pred.forward(**pred._pending)
+
+
+@_capi
+def MXPredGetOutputShape(handle, index):
+    return tuple(int(d) for d in _get(handle).outputs[index].shape)
+
+
+@_capi
+def MXPredGetOutput(handle, index):
+    out = _get(handle).outputs[index]
+    return np.ascontiguousarray(out.asnumpy(), np.float32).tobytes()
+
+
+@_capi
+def MXPredFree(handle):
+    _free(handle)

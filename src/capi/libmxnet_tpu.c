@@ -46,21 +46,35 @@ static void set_err_from_py(void) {
     Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
 }
 
-/* Initialize the interpreter + import mxnet_tpu.c_api once. */
+/* Initialize the interpreter + import mxnet_tpu.c_api once.
+ * Mutex-guarded: concurrent first calls from multiple client threads must
+ * not double-run Py_InitializeEx/PyEval_SaveThread. */
+#include <pthread.h>
+static pthread_mutex_t g_init_lock = PTHREAD_MUTEX_INITIALIZER;
+
 static int ensure_init(void) {
     if (g_capi) return 0;
+    pthread_mutex_lock(&g_init_lock);
+    if (g_capi) {
+        pthread_mutex_unlock(&g_init_lock);
+        return 0;
+    }
     if (!Py_IsInitialized()) {
         Py_InitializeEx(0);
         /* release the GIL so PyGILState_Ensure works from any thread */
         PyEval_SaveThread();
     }
     PyGILState_STATE st = PyGILState_Ensure();
-    if (!g_capi) {
-        PyObject *m = PyImport_ImportModule("mxnet_tpu.c_api");
-        if (!m) { set_err_from_py(); PyGILState_Release(st); return -1; }
-        g_capi = m;                       /* keep the reference forever */
+    PyObject *m = PyImport_ImportModule("mxnet_tpu.c_api");
+    if (!m) {
+        set_err_from_py();
+        PyGILState_Release(st);
+        pthread_mutex_unlock(&g_init_lock);
+        return -1;
     }
+    g_capi = m;                           /* keep the reference forever */
     PyGILState_Release(st);
+    pthread_mutex_unlock(&g_init_lock);
     return 0;
 }
 
@@ -476,4 +490,118 @@ MXTPU_EXPORT int MXKVStorePull(KVStoreHandle h, uint32_t num,
                                const int *keys, NDArrayHandle *vals) {
     ENSURE();
     return kv_keyvals("MXKVStorePull", h, num, keys, vals);
+}
+
+/* ---------------- C predict API (ref: c_predict_api.h) ---------------- */
+
+typedef uint64_t PredictorHandle;
+
+MXTPU_EXPORT int MXPredCreate(const char *symbol_json,
+                              const void *param_bytes, int param_size,
+                              int dev_type, int dev_id,
+                              uint32_t num_input_nodes,
+                              const char **input_keys,
+                              const uint32_t *input_shape_indptr,
+                              const uint32_t *input_shape_data,
+                              PredictorHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pk = PyList_New(num_input_nodes);
+    PyObject *ps = PyList_New(num_input_nodes);
+    for (uint32_t i = 0; i < num_input_nodes; i++) {
+        PyList_SetItem(pk, i, PyUnicode_FromString(input_keys[i]));
+        uint32_t b = input_shape_indptr[i], e = input_shape_indptr[i + 1];
+        PyObject *shape = PyTuple_New(e - b);
+        for (uint32_t j = b; j < e; j++)
+            PyTuple_SetItem(shape, j - b,
+                            PyLong_FromUnsignedLong(input_shape_data[j]));
+        PyList_SetItem(ps, i, shape);
+    }
+    PyObject *pb = PyBytes_FromStringAndSize(
+        (const char *)param_bytes, param_size);
+    PyObject *v = capi_call("MXPredCreate",
+                            Py_BuildValue("(sNiiNN)", symbol_json, pb,
+                                          dev_type, dev_id, pk, ps));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXPredSetInput(PredictorHandle h, const char *key,
+                                const float *data, uint32_t size) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *buf = PyBytes_FromStringAndSize((const char *)data,
+                                              (Py_ssize_t)size * 4);
+    PyObject *v = capi_call("MXPredSetInput",
+                            Py_BuildValue("(KsN)", h, key, buf));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXPredForward(PredictorHandle h) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXPredForward", Py_BuildValue("(K)", h));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                                      uint32_t **shape_data,
+                                      uint32_t *shape_ndim) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXPredGetOutputShape",
+                            Py_BuildValue("(KI)", h, index));
+    int rc = -1;
+    if (v) {
+        uint32_t n = (uint32_t)PySequence_Size(v);
+        uint32_t *buf = (uint32_t *)g_shape_buf;
+        for (uint32_t i = 0; i < n && i < 32; i++) {
+            PyObject *it = PySequence_GetItem(v, i);
+            buf[i] = (uint32_t)PyLong_AsUnsignedLong(it);
+            Py_DECREF(it);
+        }
+        *shape_data = buf;
+        *shape_ndim = n;
+        Py_DECREF(v);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXPredGetOutput(PredictorHandle h, uint32_t index,
+                                 float *data, uint32_t size) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXPredGetOutput", Py_BuildValue("(KI)", h,
+                                                             index));
+    int rc = -1;
+    if (v) {
+        size_t n = (size_t)PyBytes_Size(v);
+        size_t want = (size_t)size * 4;
+        if (n < want) want = n;
+        memcpy(data, PyBytes_AsString(v), want);
+        Py_DECREF(v);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXPredFree(PredictorHandle h) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = capi_call("MXPredFree", Py_BuildValue("(K)", h));
+    int rc = v ? 0 : -1;
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    return rc;
 }

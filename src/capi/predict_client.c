@@ -1,0 +1,93 @@
+/*
+ * C predict client: load a -symbol.json + .params checkpoint and run
+ * inference through the MXPred ABI (ref: include/mxnet/c_predict_api.h;
+ * the amalgamation/mobile deploy story).
+ *
+ * Usage: predict_client <symbol.json> <file.params> <batch> <feat>
+ * Prints the argmax of each row's output.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef uint64_t PredictorHandle;
+extern const char *MXGetLastError(void);
+extern int MXPredCreate(const char *, const void *, int, int, int, uint32_t,
+                        const char **, const uint32_t *, const uint32_t *,
+                        PredictorHandle *);
+extern int MXPredSetInput(PredictorHandle, const char *, const float *,
+                          uint32_t);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredGetOutputShape(PredictorHandle, uint32_t, uint32_t **,
+                                uint32_t *);
+extern int MXPredGetOutput(PredictorHandle, uint32_t, float *, uint32_t);
+extern int MXPredFree(PredictorHandle);
+
+#define CHK(c)                                                       \
+    do {                                                             \
+        if ((c) != 0) {                                              \
+            fprintf(stderr, "FAIL %s: %s\n", #c, MXGetLastError());  \
+            return 1;                                                \
+        }                                                            \
+    } while (0)
+
+static char *read_file(const char *path, long *size) {
+    FILE *f = fopen(path, "rb");
+    if (!f) { perror(path); exit(1); }
+    fseek(f, 0, SEEK_END);
+    *size = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char *buf = malloc(*size + 1);
+    if (fread(buf, 1, *size, f) != (size_t)*size) { perror("read"); exit(1); }
+    buf[*size] = 0;
+    fclose(f);
+    return buf;
+}
+
+int main(int argc, char **argv) {
+    if (argc != 5) {
+        fprintf(stderr, "usage: %s sym.json file.params batch feat\n",
+                argv[0]);
+        return 2;
+    }
+    long jsize, psize;
+    char *json = read_file(argv[1], &jsize);
+    char *params = read_file(argv[2], &psize);
+    uint32_t batch = (uint32_t)atoi(argv[3]);
+    uint32_t feat = (uint32_t)atoi(argv[4]);
+
+    const char *keys[] = {"data"};
+    uint32_t indptr[] = {0, 2};
+    uint32_t shape[] = {batch, feat};
+    PredictorHandle h;
+    CHK(MXPredCreate(json, params, (int)psize, 1, 0, 1, keys, indptr,
+                     shape, &h));
+
+    float *x = malloc(sizeof(float) * batch * feat);
+    for (uint32_t i = 0; i < batch * feat; i++)
+        x[i] = (float)((i * 37 % 100)) / 100.f;
+    CHK(MXPredSetInput(h, "data", x, batch * feat));
+    CHK(MXPredForward(h));
+
+    uint32_t *oshape, ondim;
+    CHK(MXPredGetOutputShape(h, 0, &oshape, &ondim));
+    uint32_t osize = 1;
+    printf("output shape:");
+    for (uint32_t i = 0; i < ondim; i++) {
+        printf(" %u", oshape[i]);
+        osize *= oshape[i];
+    }
+    printf("\n");
+    float *out = malloc(sizeof(float) * osize);
+    CHK(MXPredGetOutput(h, 0, out, osize));
+    uint32_t classes = osize / batch;
+    for (uint32_t i = 0; i < batch; i++) {
+        uint32_t best = 0;
+        for (uint32_t c = 1; c < classes; c++)
+            if (out[i * classes + c] > out[i * classes + best]) best = c;
+        printf("row %u argmax %u\n", i, best);
+    }
+    CHK(MXPredFree(h));
+    printf("PREDICT PASS\n");
+    return 0;
+}

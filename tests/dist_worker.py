@@ -86,6 +86,15 @@ def run_kvstore(mx, rank, nproc):
     kv4.pull("b", out=o4)
     np.testing.assert_allclose(o4.asnumpy(), 10 * np.ones(shape))
 
+    # liveness: every peer is beating over the coordination service, so
+    # no node is dead (ref contract: kvstore_dist.h:159-168 GetDeadNodes)
+    kv.barrier()                 # all ranks published their first beat
+    assert kv.num_dead_node(0, timeout_sec=60) == 0, \
+        "healthy cluster reported dead nodes"
+    # a rank that never existed counts dead against a tight horizon
+    hb = kv._heartbeat
+    assert hb is not None and hb.dead_nodes(nproc + 1, timeout_sec=60) >= 1
+
     kv.barrier()
 
 

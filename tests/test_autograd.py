@@ -69,3 +69,27 @@ def test_out_grads():
         y = x * 4
     ag.compute_gradient([y], out_grads=[nd.array(np.array([1.0, 0.5]))])
     assert np.allclose(gx.asnumpy(), [4.0, 2.0])
+
+
+def test_multi_iteration_tape_id_reuse():
+    """Regression (r4): dead intermediates' id()s recycled across/within
+    record sections cross-wired the tape replay (mul shape error on the
+    2nd training iteration). The tape must hold its outputs alive and
+    reset per outermost section."""
+    rng = np.random.RandomState(0)
+    w1 = nd.array(rng.randn(6, 8).astype(np.float32) * 0.1)
+    w2 = nd.array(rng.randn(8, 3).astype(np.float32) * 0.1)
+    g1, g2 = nd.zeros((6, 8)), nd.zeros((8, 3))
+    ag.mark_variables([w1, w2], [g1, g2])
+    losses = []
+    for it in range(4):
+        x = nd.array(rng.randn(5, 6).astype(np.float32))
+        with ag.train_section():
+            h = nd.relu(nd.dot(x, w1))
+            out = nd.dot(h, w2)
+            loss = nd.sum(out * out)
+        ag.compute_gradient([loss])
+        w1[:] = w1.asnumpy() - 0.01 * g1.asnumpy()
+        w2[:] = w2.asnumpy() - 0.01 * g2.asnumpy()
+        losses.append(float(loss.asnumpy()))
+    assert np.isfinite(losses).all()
