@@ -82,19 +82,18 @@ def main():
     else:
         # native fused decode/augment engine (src/io/image_decode.cc);
         # part_index/num_parts shard the input across dist_sync workers
-        kv_tmp = kvstore
         norm = dict(mean_r=123.68, mean_g=116.78, mean_b=103.94,
                     std_r=58.395, std_g=57.12, std_b=57.375)
         train = mx.image.ImageRecordIter(
             path_imgrec=args.data_train, data_shape=image_shape,
             batch_size=args.batch_size, shuffle=True, rand_crop=True,
             rand_mirror=True, resize=256,
-            part_index=kv_tmp.rank, num_parts=kv_tmp.num_workers, **norm)
+            part_index=kvstore.rank, num_parts=kvstore.num_workers, **norm)
         # val sharded like train: each worker scores its slice
         val = None if args.data_val is None else mx.image.ImageRecordIter(
             path_imgrec=args.data_val, data_shape=image_shape,
             batch_size=args.batch_size, resize=256,
-            part_index=kv_tmp.rank, num_parts=kv_tmp.num_workers, **norm)
+            part_index=kvstore.rank, num_parts=kvstore.num_workers, **norm)
 
     # epoch-boundary lr schedule (ref: fit.py _get_lr_scheduler)
     epoch_size = args.epoch_size

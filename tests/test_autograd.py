@@ -74,8 +74,9 @@ def test_out_grads():
 def test_multi_iteration_tape_id_reuse():
     """Regression (r4): dead intermediates' id()s recycled across/within
     record sections cross-wired the tape replay (mul shape error on the
-    2nd training iteration). The tape must hold its outputs alive and
-    reset per outermost section."""
+    2nd training iteration). Tape entries hold their outputs alive so node
+    keys cannot be reused; compute_gradient consumes and clears the tape
+    (recording without ever computing accumulates, as in the reference)."""
     rng = np.random.RandomState(0)
     w1 = nd.array(rng.randn(6, 8).astype(np.float32) * 0.1)
     w2 = nd.array(rng.randn(8, 3).astype(np.float32) * 0.1)
