@@ -67,9 +67,18 @@ class TrainStep(object):
             # an instance's rescale_grad is authoritative (even 1.0): the
             # imperative updater applies it verbatim, so the fused path must
             # too; the 1/batch_size default exists only for the
-            # string-optimizer convenience constructor
+            # string-optimizer convenience constructor. A left-at-default
+            # 1.0 almost always means batch-SUMMED gradients at full lr —
+            # warn like Module.init_optimizer does (ref: module.py:460-463)
             if rescale_grad is None:
                 rescale_grad = optimizer.rescale_grad
+                if rescale_grad == 1.0:
+                    import logging
+                    logging.warning(
+                        "TrainStep: optimizer instance has rescale_grad=1.0 "
+                        "(gradients are batch sums); pass "
+                        "rescale_grad=1/batch_size to the optimizer or to "
+                        "TrainStep if per-example scaling is intended")
         else:
             kwargs = {"learning_rate": learning_rate, "wd": wd,
                       "sym": symbol}
