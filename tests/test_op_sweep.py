@@ -297,7 +297,8 @@ SHAPE_OPS = {
                   lambda i, w: w[i.astype(int)]),
 }
 _SHAPE_DIFF = {"Reshape", "reshape", "Flatten", "flatten", "transpose",
-               "expand_dims", "SwapAxis", "swapaxes", "tile", "slice",
+               "expand_dims", "SwapAxis", "swapaxes", "tile", "repeat",
+               "flip", "broadcast_to", "slice",
                "slice_axis", "dot", "batch_dot", "Concat", "concat",
                "smooth_l1", "log_softmax", "softmax"}
 
@@ -502,3 +503,34 @@ def test_registry_coverage():
     assert not missing, "ops with no test coverage: %s" % missing
     exercised = here | {n for n in COVERED_ELSEWHERE}
     assert len(exercised) >= 200, len(exercised)
+
+
+# ---------------------------------------------------------------------------
+# extended gradient coverage: indexed / select / pad family
+# ---------------------------------------------------------------------------
+def test_embedding_gradient_wrt_weight():
+    idx = np.array([0, 2, 1, 2], np.float32)
+    w = _gen((4, 5))
+    fd_grad_check("Embedding", [idx, w],
+                  {"input_dim": "4", "output_dim": "5"}, wrt=[1])
+
+
+def test_take_gradient_wrt_data():
+    fd_grad_check("take", [_gen((4, 3)), np.array([0, 2, 2], np.float32)],
+                  wrt=[0])
+
+
+def test_where_gradient_wrt_branches():
+    cond = np.array([1, 0, 1, 0], np.float32)
+    fd_grad_check("where", [cond, _gen((4,)), _gen((4,))], wrt=[1, 2])
+
+
+def test_pad_gradient():
+    fd_grad_check("Pad", [_gen((1, 2, 3, 3))],
+                  {"mode": "constant", "pad_width": "(0,0,0,0,1,1,1,1)"})
+
+
+def test_clip_gradient_interior():
+    x = (_unit(S) * 0.35)            # strictly inside (-0.5, 0.5): smooth
+    fd_grad_check("clip", [x], {"a_min": "-0.5", "a_max": "0.5"})
+
