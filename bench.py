@@ -73,13 +73,20 @@ def main():
     layout = os.environ.get("BENCH_LAYOUT", "NCHW")
     dshape = ((batch, image, image, 3) if layout == "NHWC"
               else (batch, 3, image, image))
+    # BENCH_STORAGE_DTYPE=bfloat16 stores params+optimizer state in bf16
+    # (no f32 masters) — measured r5, see docs/perf.md
+    sdtype = os.environ.get("BENCH_STORAGE_DTYPE", "float32")
     sym = models.resnet(num_classes=1000, num_layers=depth,
                         image_shape="3,%d,%d" % (image, image),
                         layout=layout)
     step = TrainStep(sym, optimizer="sgd", learning_rate=0.1, momentum=0.9,
-                     wd=1e-4,
+                     wd=1e-4, dtype=sdtype,
                      remat={"conv": "conv", "full": True}.get(remat, False),
                      compute_dtype=None if cdtype == "float32" else cdtype)
+    # storage dtype != f32 forces compute to the storage dtype inside
+    # TrainStep; label the run by what actually executed
+    if step.compute_dtype is not None:
+        cdtype = np.dtype(step.compute_dtype).name
     state = step.init({"data": dshape}, {"softmax_label": (batch,)})
 
     rng = np.random.default_rng(0)
@@ -138,9 +145,11 @@ def main():
               file=sys.stderr)
 
     peak, kind = _peak_flops(jax.devices()[0])
+    metric = "resnet%d_train_images_per_sec_b%d_%s" % (depth, batch, cdtype)
+    if sdtype != "float32":
+        metric += "_store_%s" % sdtype
     out = {
-        "metric": "resnet%d_train_images_per_sec_b%d_%s" % (depth, batch,
-                                                            cdtype),
+        "metric": metric,
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / baseline, 3),
