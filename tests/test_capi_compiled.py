@@ -39,6 +39,93 @@ def test_compiled_capi_smoke_client_trains():
     assert "SMOKE PASS" in r.stdout
 
 
+def test_abi_client_families():
+    """r5 ABI families end-to-end in pure C: op introspection, training
+    from a C-created DataIter, C updater callback, autograd, RecordIO
+    (ref: c_api.h DataIter/autograd/RecordIO/introspection families;
+    VERDICT r4 item 2 done-criteria)."""
+    if shutil.which("cc") is None:
+        pytest.skip("no C toolchain")
+    client = os.path.join(ROOT, "lib", "abi_client")
+    src_newer = (not os.path.exists(client)
+                 or os.path.getmtime(os.path.join(SRC, "abi_client.c"))
+                 > os.path.getmtime(client)
+                 or os.path.getmtime(os.path.join(SRC, "libmxnet_tpu.c"))
+                 > os.path.getmtime(client))
+    if src_newer:
+        ok, log = _build()
+        assert ok, "build failed:\n%s" % log
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([client], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, "abi client failed:\nstdout:%s\nstderr:%s" \
+        % (r.stdout, r.stderr)
+    assert "ABI PASS" in r.stdout
+    assert "introspection: 2" in r.stdout  # 200+ ops through the ABI
+    assert "updater calls" in r.stdout
+
+
+def test_abi_covers_all_114_reference_functions():
+    """Every `MXNET_DLL int MX*` in the reference c_api.h must be exported
+    by the compiled .so (ref: include/mxnet/c_api.h — the contract every
+    binding consumes)."""
+    import re
+    if not os.path.exists(LIB):
+        pytest.skip("lib not built")
+    ref_h = "/root/reference/include/mxnet/c_api.h"
+    if not os.path.exists(ref_h):
+        pytest.skip("reference not available")
+    with open(ref_h) as f:
+        ref_fns = set(re.findall(r"MXNET_DLL int (MX[A-Za-z0-9]+)",
+                                 f.read()))
+    r = subprocess.run(["nm", "-D", LIB], capture_output=True, text=True)
+    exported = set(re.findall(r" T (MX[A-Za-z0-9]+)", r.stdout))
+    missing = sorted(ref_fns - exported)
+    assert not missing, "ABI missing %d reference functions: %s" % (
+        len(missing), missing)
+
+
+def test_op_enumeration_through_compiled_abi_ctypes():
+    """Enumerate ops + arg docs purely through the compiled ABI from
+    python/ctypes — the mechanical path a binding generator uses (ref:
+    OpWrapperGenerator.py over MXSymbolGetAtomicSymbolInfo)."""
+    import ctypes
+    if not os.path.exists(LIB):
+        pytest.skip("lib not built")
+    # the .so embeds CPython: loading it into this process is fine (it
+    # reuses the live interpreter via PyGILState)
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    n = ctypes.c_uint(0)
+    arr = ctypes.POINTER(ctypes.c_uint64)()
+    assert lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(arr)) == 0, lib.MXGetLastError()
+    assert n.value > 200
+    seen = {}
+    for i in range(n.value):
+        name = ctypes.c_char_p()
+        desc = ctypes.c_char_p()
+        na = ctypes.c_uint()
+        an = ctypes.POINTER(ctypes.c_char_p)()
+        at = ctypes.POINTER(ctypes.c_char_p)()
+        ad = ctypes.POINTER(ctypes.c_char_p)()
+        kv = ctypes.c_char_p()
+        rt = ctypes.c_char_p()
+        assert lib.MXSymbolGetAtomicSymbolInfo(
+            ctypes.c_uint64(arr[i]), ctypes.byref(name), ctypes.byref(desc),
+            ctypes.byref(na), ctypes.byref(an), ctypes.byref(at),
+            ctypes.byref(ad), ctypes.byref(kv), ctypes.byref(rt)) == 0
+        seen[name.value.decode()] = [an[j].decode() for j in range(na.value)]
+    assert "Convolution" in seen and seen["Convolution"][0] == "data"
+    assert "FullyConnected" in seen
+    assert "BatchNorm" in seen
+    # registry parity: the ABI must see exactly what python sees
+    from mxnet_tpu.ops import list_ops
+    assert set(seen) == set(list_ops())
+
+
 def test_exported_symbols_are_c_linkage():
     if not os.path.exists(LIB):
         pytest.skip("lib not built")
