@@ -6,6 +6,9 @@ make -C src
 make -C src/capi
 c++ -O2 -std=c++14 -I cpp-package/include cpp-package/example/train_mlp.cpp \
     -L lib -lmxnet_tpu -Wl,-rpath,'$ORIGIN' -o lib/train_mlp_cpp
+# C++ LeNet through the generated op wrappers (built by make -C src/capi;
+# run gated on holdout accuracy >= 0.95)
+PYTHONPATH=. JAX_PLATFORMS=cpu ./lib/lenet_cpp
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/ -q
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -45,6 +45,32 @@ int MXKVStoreCreate(const char *, MXTHandle *);
 int MXKVStoreInit(MXTHandle, uint32_t, const int *, MXTHandle *);
 int MXKVStorePush(MXTHandle, uint32_t, const int *, MXTHandle *);
 int MXKVStorePull(MXTHandle, uint32_t, const int *, MXTHandle *);
+typedef void(MXKVStoreUpdaterFn)(int, MXTHandle, MXTHandle, void *);
+int MXKVStoreSetUpdater(MXTHandle, MXKVStoreUpdaterFn *, void *);
+int MXKVStoreFree(MXTHandle);
+int MXSymbolListAuxiliaryStates(MXTHandle, uint32_t *, const char ***);
+int MXSymbolListOutputs(MXTHandle, uint32_t *, const char ***);
+int MXSymbolInferShape(MXTHandle, uint32_t, const char **, const uint32_t *,
+                       const uint32_t *, uint32_t *, const uint32_t **,
+                       const uint32_t ***, uint32_t *, const uint32_t **,
+                       const uint32_t ***, uint32_t *, const uint32_t **,
+                       const uint32_t ***, int *);
+int MXGetFunction(const char *, MXTHandle *);
+int MXFuncInvokeEx(MXTHandle, MXTHandle *, float *, MXTHandle *, int,
+                   const char **, const char **);
+int MXListDataIters(uint32_t *, MXTHandle **);
+int MXDataIterGetIterInfo(MXTHandle, const char **, const char **,
+                          uint32_t *, const char ***, const char ***,
+                          const char ***);
+int MXDataIterCreateIter(MXTHandle, uint32_t, const char **, const char **,
+                         MXTHandle *);
+int MXDataIterNext(MXTHandle, int *);
+int MXDataIterBeforeFirst(MXTHandle);
+int MXDataIterGetData(MXTHandle, MXTHandle *);
+int MXDataIterGetLabel(MXTHandle, MXTHandle *);
+int MXDataIterGetPadNum(MXTHandle, int *);
+int MXDataIterFree(MXTHandle);
+int MXRandomSeed(int);
 }
 
 namespace mxnet_tpu {
@@ -144,6 +170,19 @@ class Symbol {
     MXTPU_CHECK(MXSymbolSaveToJSON(handle_, &s));
     return std::string(s);
   }
+  std::vector<std::string> ListOutputs() const {
+    uint32_t n = 0;
+    const char **names = nullptr;
+    MXTPU_CHECK(MXSymbolListOutputs(handle_, &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    uint32_t n = 0;
+    const char **names = nullptr;
+    MXTPU_CHECK(MXSymbolListAuxiliaryStates(handle_, &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  static Symbol FromHandle(MXTHandle h) { return Symbol(h); }
   MXTHandle handle() const { return handle_; }
 
  private:
@@ -196,9 +235,279 @@ class KVStore {
     MXTHandle h = v->handle();
     MXTPU_CHECK(MXKVStorePull(handle_, 1, &key, &h));
   }
+  /* register a C updater applied on every push
+   * (ref: cpp-package kvstore.hpp SetUpdater over MXKVStoreSetUpdater) */
+  void SetUpdater(MXKVStoreUpdaterFn *fn, void *closure = nullptr) {
+    MXTPU_CHECK(MXKVStoreSetUpdater(handle_, fn, closure));
+  }
 
  private:
   MXTHandle handle_;
+};
+
+/* =====================================================================
+ * r5 additions: the reference cpp-package's user-facing classes —
+ * Operator builder (the substrate of generated op.h), Optimizer zoo,
+ * MXDataIter, Symbol shape inference + SimpleBind
+ * (ref: cpp-package/include/mxnet-cpp/{operator.h,optimizer.hpp,io.hpp,
+ * symbol.hpp}).
+ * ===================================================================== */
+
+/*! \brief op builder: Operator("Convolution").SetParam(...).AddInput(...)
+ *         .CreateSymbol(name) — what generated op.h functions lower to */
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_name_(op_name) {}
+  Operator &SetParam(const std::string &k, const std::string &v) {
+    params_[k] = v;
+    return *this;
+  }
+  Operator &SetParams(const std::map<std::string, std::string> &m) {
+    for (const auto &kv : m) params_[kv.first] = kv.second;
+    return *this;
+  }
+  Operator &AddInput(const Symbol &s) {
+    inputs_.push_back(s.handle());
+    return *this;
+  }
+  Symbol CreateSymbol(const std::string &name) {
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    MXTHandle h = 0;
+    MXTPU_CHECK(MXSymbolCreateAtomicSymbol(
+        op_name_.c_str(), static_cast<uint32_t>(keys.size()), keys.data(),
+        vals.data(), &h));
+    /* positional compose: missing trailing inputs (weights/bias) become
+     * auto-named variables, exactly like the python frontend */
+    MXTPU_CHECK(MXSymbolCompose(h, name.c_str(),
+                                static_cast<uint32_t>(inputs_.size()),
+                                nullptr, inputs_.data()));
+    return Symbol::FromHandle(h);
+  }
+
+ private:
+  std::string op_name_;
+  std::map<std::string, std::string> params_;
+  std::vector<MXTHandle> inputs_;
+};
+
+/*! \brief shapes for Symbol::InferShape results */
+typedef std::vector<std::vector<uint32_t>> ShapeVec;
+
+/*! \brief infer arg/out/aux shapes from named input shapes */
+inline void InferShape(const Symbol &sym,
+                       const std::map<std::string, std::vector<uint32_t>>
+                           &input_shapes,
+                       ShapeVec *arg_shapes, ShapeVec *out_shapes,
+                       ShapeVec *aux_shapes) {
+  std::vector<const char *> keys;
+  std::vector<uint32_t> indptr{0}, data;
+  for (const auto &kv : input_shapes) {
+    keys.push_back(kv.first.c_str());
+    for (uint32_t d : kv.second) data.push_back(d);
+    indptr.push_back(static_cast<uint32_t>(data.size()));
+  }
+  uint32_t isz, osz, asz;
+  const uint32_t *ind, *ond, *and_;
+  const uint32_t **idat, **odat, **adat;
+  int complete = 0;
+  MXTPU_CHECK(MXSymbolInferShape(
+      sym.handle(), static_cast<uint32_t>(keys.size()), keys.data(),
+      indptr.data(), data.data(), &isz, &ind, &idat, &osz, &ond, &odat,
+      &asz, &and_, &adat, &complete));
+  if (!complete) throw std::runtime_error("InferShape: incomplete");
+  auto fill = [](ShapeVec *out, uint32_t n, const uint32_t *nd,
+                 const uint32_t **dat) {
+    if (!out) return;
+    out->clear();
+    for (uint32_t i = 0; i < n; i++)
+      out->emplace_back(dat[i], dat[i] + nd[i]);
+  };
+  fill(arg_shapes, isz, ind, idat);
+  fill(out_shapes, osz, ond, odat);
+  fill(aux_shapes, asz, and_, adat);
+}
+
+/*! \brief optimizer over the fused update ops (sgd_update / sgd_mom_update
+ *         / adam_update invoked through MXFuncInvokeEx with the weight as a
+ *         mutate var — ref: optimizer.hpp over the NDArray update ops) */
+class Optimizer {
+ public:
+  static Optimizer *Create(const std::string &type) {
+    return new Optimizer(type);
+  }
+  Optimizer(const Optimizer &) = delete;
+  Optimizer &operator=(const Optimizer &) = delete;
+  Optimizer &SetParam(const std::string &k, const std::string &v) {
+    params_[k] = v;
+    return *this;
+  }
+  /* apply one update step in-place on weight (and lazily-created state);
+   * table-driven over the fused update ops: {op, n_state_slots} */
+  void Update(int index, NDArray *weight, const NDArray &grad) {
+    const char *op_name;
+    int n_state;
+    if (type_ == "sgd") {
+      op_name = "sgd_update"; n_state = 0;
+    } else if (type_ == "sgd_mom") {
+      op_name = "sgd_mom_update"; n_state = 1;
+    } else if (type_ == "adam") {
+      op_name = "adam_update"; n_state = 2;
+    } else if (type_ == "rmsprop") {
+      op_name = "rmsprop_update"; n_state = 1;
+    } else {
+      throw std::runtime_error("Optimizer: unknown type " + type_);
+    }
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    std::vector<MXTHandle> use{weight->handle(), grad.handle()};
+    std::vector<MXTHandle> mut{weight->handle()};
+    for (int s = 0; s < n_state; s++) {
+      MXTHandle st = State(index, s, *weight)->handle();
+      use.push_back(st);
+      mut.push_back(st);
+    }
+    MXTPU_CHECK(MXFuncInvokeEx(Fn(op_name), use.data(), nullptr, mut.data(),
+                               static_cast<int>(keys.size()), keys.data(),
+                               vals.data()));
+  }
+  ~Optimizer() {
+    for (auto &kv : states_) delete kv.second;
+  }
+
+ private:
+  explicit Optimizer(const std::string &type) : type_(type) {}
+  MXTHandle Fn(const std::string &name) {
+    auto it = fns_.find(name);
+    if (it != fns_.end()) return it->second;
+    MXTHandle fn = 0;
+    MXTPU_CHECK(MXGetFunction(name.c_str(), &fn));
+    fns_[name] = fn;
+    return fn;
+  }
+  std::map<std::string, MXTHandle> fns_;
+  NDArray *State(int index, int slot, const NDArray &like) {
+    auto key = index * 4 + slot;
+    auto it = states_.find(key);
+    if (it != states_.end()) return it->second;
+    NDArray *st = new NDArray(like.Shape());
+    std::vector<float> zeros(Size(like.Shape()), 0.f);
+    st->CopyFrom(zeros);
+    states_[key] = st;
+    return st;
+  }
+  static size_t Size(const std::vector<uint32_t> &shape) {
+    size_t n = 1;
+    for (uint32_t d : shape) n *= d;
+    return n;
+  }
+  std::string type_;
+  std::map<std::string, std::string> params_;
+  std::map<int, NDArray *> states_;
+};
+
+/*! \brief data iterator over the ABI's registered creators
+ *         (ref: io.hpp MXDataIter) */
+class MXDataIter {
+ public:
+  explicit MXDataIter(const std::string &iter_name) : name_(iter_name) {}
+  MXDataIter(const MXDataIter &) = delete;
+  MXDataIter &operator=(const MXDataIter &) = delete;
+  MXDataIter(MXDataIter &&o) noexcept
+      : name_(std::move(o.name_)), params_(std::move(o.params_)),
+        handle_(o.handle_) {
+    o.handle_ = 0;
+  }
+  MXDataIter &SetParam(const std::string &k, const std::string &v) {
+    params_[k] = v;
+    return *this;
+  }
+  void CreateDataIter() {
+    uint32_t n = 0;
+    MXTHandle *creators = nullptr;
+    MXTPU_CHECK(MXListDataIters(&n, &creators));
+    MXTHandle creator = 0;
+    bool found = false;
+    for (uint32_t i = 0; i < n; i++) {
+      const char *nm, *desc;
+      uint32_t na;
+      const char **an, **at, **ad;
+      MXTPU_CHECK(MXDataIterGetIterInfo(creators[i], &nm, &desc, &na, &an,
+                                        &at, &ad));
+      if (name_ == nm) {
+        creator = creators[i];
+        found = true;
+        break;  /* creator value captured; later ABI calls may reuse slots */
+      }
+    }
+    if (!found) throw std::runtime_error("unknown DataIter " + name_);
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    MXTPU_CHECK(MXDataIterCreateIter(creator,
+                                     static_cast<uint32_t>(keys.size()),
+                                     keys.data(), vals.data(), &handle_));
+  }
+  bool Next() {
+    int has = 0;
+    MXTPU_CHECK(MXDataIterNext(handle_, &has));
+    return has != 0;
+  }
+  void Reset() { MXTPU_CHECK(MXDataIterBeforeFirst(handle_)); }
+  /* current batch arrays: caller owns the returned handle lifetimes via
+   * NDArray::CopyHandle or MXNDArrayFree */
+  MXTHandle GetData() {
+    MXTHandle h = 0;
+    MXTPU_CHECK(MXDataIterGetData(handle_, &h));
+    return h;
+  }
+  MXTHandle GetLabel() {
+    MXTHandle h = 0;
+    MXTPU_CHECK(MXDataIterGetLabel(handle_, &h));
+    return h;
+  }
+  int GetPadNum() {
+    int pad = 0;
+    MXTPU_CHECK(MXDataIterGetPadNum(handle_, &pad));
+    return pad;
+  }
+  ~MXDataIter() {
+    if (handle_) MXDataIterFree(handle_);
+  }
+
+ private:
+  std::string name_;
+  std::map<std::string, std::string> params_;
+  MXTHandle handle_ = 0;
+};
+
+/*! \brief accuracy metric (ref: cpp-package metric.h) */
+class Accuracy {
+ public:
+  void Update(const std::vector<float> &labels,
+              const std::vector<float> &probs, size_t batch,
+              size_t num_class) {
+    for (size_t i = 0; i < batch; i++) {
+      size_t best = 0;
+      for (size_t c = 1; c < num_class; c++)
+        if (probs[i * num_class + c] > probs[i * num_class + best]) best = c;
+      correct_ += (static_cast<size_t>(labels[i]) == best);
+      total_ += 1;
+    }
+  }
+  float Get() const { return total_ ? 1.f * correct_ / total_ : 0.f; }
+  void Reset() { correct_ = total_ = 0; }
+
+ private:
+  size_t correct_ = 0, total_ = 0;
 };
 
 }  // namespace mxnet_tpu

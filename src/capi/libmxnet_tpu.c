@@ -1357,6 +1357,21 @@ static int infer_shape_impl(const char *fname, SymbolHandle sym,
     PyObject *v = capi_call(fname, Py_BuildValue("(KNN)", sym, pk, ps));
     int rc = -1;
     if (v && PyTuple_Check(v) && PyTuple_Size(v) == 3) {
+        /* completeness = no None entries in the arg/output groups; a None
+         * is "unknown", an empty tuple is a legitimate scalar shape —
+         * ndim alone cannot distinguish them */
+        if (complete) {
+            *complete = 1;
+            for (int g = 0; g < 2; g++) {
+                PyObject *lst = PyTuple_GetItem(v, g);
+                Py_ssize_t n = PySequence_Size(lst);
+                for (Py_ssize_t i = 0; i < n; i++) {
+                    PyObject *s = PySequence_GetItem(lst, i);
+                    if (s == Py_None) *complete = 0;
+                    Py_XDECREF(s);
+                }
+            }
+        }
         shape_group_fill(0, PyTuple_GetItem(v, 0));
         shape_group_fill(1, PyTuple_GetItem(v, 1));
         shape_group_fill(2, PyTuple_GetItem(v, 2));
@@ -1366,14 +1381,6 @@ static int infer_shape_impl(const char *fname, SymbolHandle sym,
         *out_data = (const mx_uint **)g_sg[1].datas;
         *aux_size = g_sg[2].n; *aux_ndim = g_sg[2].ndims;
         *aux_data = (const mx_uint **)g_sg[2].datas;
-        if (complete) {
-            /* a partial infer returns None entries -> ndim 0 in the arg or
-             * output groups (aux may legitimately be empty) */
-            *complete = 1;
-            for (int g = 0; g < 2; g++)
-                for (mx_uint i = 0; i < g_sg[g].n; i++)
-                    if (g_sg[g].ndims[i] == 0) *complete = 0;
-        }
         rc = 0;
     }
     Py_XDECREF(v);
