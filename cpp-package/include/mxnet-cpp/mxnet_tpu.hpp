@@ -361,8 +361,20 @@ class Optimizer {
     } else {
       throw std::runtime_error("Optimizer: unknown type " + type_);
     }
+    std::map<std::string, std::string> p(params_);
+    if (type_ == "adam") {
+      /* bias correction: like the reference's python/cpp Adam classes,
+       * the host passes a corrected lr to the raw adam_update op
+       * (ref: python/mxnet/optimizer.py Adam.update) */
+      double t = ++counts_[index];
+      double b1 = p.count("beta1") ? std::stod(p["beta1"]) : 0.9;
+      double b2 = p.count("beta2") ? std::stod(p["beta2"]) : 0.999;
+      double lr = p.count("lr") ? std::stod(p["lr"]) : 0.001;
+      lr *= std::sqrt(1.0 - std::pow(b2, t)) / (1.0 - std::pow(b1, t));
+      p["lr"] = std::to_string(lr);
+    }
     std::vector<const char *> keys, vals;
-    for (auto &kv : params_) {
+    for (auto &kv : p) {
       keys.push_back(kv.first.c_str());
       vals.push_back(kv.second.c_str());
     }
@@ -410,6 +422,7 @@ class Optimizer {
   std::string type_;
   std::map<std::string, std::string> params_;
   std::map<int, NDArray *> states_;
+  std::map<int, long> counts_;  /* per-weight update counter (adam t) */
 };
 
 /*! \brief data iterator over the ABI's registered creators

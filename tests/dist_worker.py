@@ -37,6 +37,15 @@ def main():
         run_kvstore(mx, rank, nproc)
     elif mode == "lenet":
         run_lenet(mx, rank, nproc)
+    elif mode == "deadworker":
+        run_deadworker(mx, rank, nproc)
+        # skip atexit/jax.distributed shutdown: the dead peer would make
+        # the orderly shutdown barrier hang (ref: barrier_before_exit,
+        # kvstore_dist.h:50-57)
+        print("RANK-%d-PASS" % rank, flush=True)
+        os._exit(0)
+    elif mode == "resume":
+        run_resume(mx, rank, nproc)
     else:
         raise SystemExit("unknown mode %r" % mode)
     print("RANK-%d-PASS" % rank, flush=True)
@@ -96,6 +105,100 @@ def run_kvstore(mx, rank, nproc):
     assert hb is not None and hb.dead_nodes(nproc + 1, timeout_sec=60) >= 1
 
     kv.barrier()
+
+
+def run_deadworker(mx, rank, nproc):
+    """Fault injection: the highest rank SIGKILLs itself; survivors must
+    see num_dead_node > 0 within the heartbeat timeout (the scenario
+    kvstore_dist.h:159-168's GetDeadNodes exists for). Rank 0 hosts the
+    coordination service, so the victim is the LAST rank."""
+    import signal
+    import time
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == nproc
+    kv.barrier()                     # every rank has published its beat
+    assert kv.num_dead_node(0, timeout_sec=60) == 0, \
+        "cluster reported dead nodes before the kill"
+
+    victim = nproc - 1
+    if rank == victim:
+        os.kill(os.getpid(), signal.SIGKILL)     # no goodbye, no cleanup
+        raise AssertionError("unreachable")
+
+    # survivors: poll until the victim's heartbeat goes stale. Beat
+    # interval is 2s; a 4s staleness horizon flags it on the first or
+    # second missed beat. NO barriers from here on (the peer is gone).
+    deadline = time.time() + 90
+    dead = 0
+    while time.time() < deadline:
+        dead = kv.num_dead_node(0, timeout_sec=4)
+        if dead >= 1:
+            break
+        time.sleep(1)
+    assert dead >= 1, "rank %d never detected the killed worker" % rank
+
+
+def run_resume(mx, rank, nproc):
+    """Checkpoint mid-training, resume in a FRESH module, finish training
+    (ref: Module.save_checkpoint/load + --load-epoch resume,
+    example/image-classification/common/fit.py)."""
+    from mxnet_tpu.io import NDArrayIter
+
+    n_class, dim, n_per = 8, 32, 256
+    rng = np.random.RandomState(7)
+    templates = rng.randn(n_class, dim).astype(np.float32) * 3
+    labels_all = np.arange(n_class * n_per) % n_class
+    x_all = (templates[labels_all]
+             + rng.randn(len(labels_all), dim).astype(np.float32) * 0.5)
+    x, y = x_all[rank::nproc], labels_all[rank::nproc].astype(np.float32)
+
+    def net():
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+        h = mx.sym.Activation(h, name="relu1", act_type="relu")
+        h = mx.sym.FullyConnected(h, name="fc2", num_hidden=n_class)
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    prefix = os.path.join(os.environ.get("MXTPU_TEST_TMPDIR", "/tmp"),
+                          "dist_resume")
+    mid_epoch = 3
+
+    mod = mx.mod.Module(net())
+    train = NDArrayIter(x, y, batch_size=64, shuffle=False)
+    mod.fit(train, num_epoch=mid_epoch, kvstore="dist_sync",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    # replicas are consistent, so every rank saves an identical checkpoint;
+    # rank 0's copy is authoritative (ref: per-rank prefixes, fit.py:25-44)
+    if rank == 0:
+        mod.save_checkpoint(prefix, mid_epoch, save_optimizer_states=True)
+    kv0 = mx.kv.create("dist_sync")
+    kv0.barrier()                   # checkpoint visible before anyone loads
+
+    # resume in a FRESH module from the saved state (mid-training restart)
+    mod2 = mx.mod.Module.load(prefix, mid_epoch,
+                              load_optimizer_states=True)
+    train.reset()
+    mod2.fit(train, num_epoch=8, begin_epoch=mid_epoch,
+             kvstore="dist_sync", optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    score = mod2.score(NDArrayIter(x, y, batch_size=64), "acc")
+    acc = dict(score)["accuracy"]
+    assert acc >= 0.95, "rank %d resumed accuracy %.3f < 0.95" % (rank, acc)
+
+    # resumed replicas must agree across workers
+    arg_params, _ = mod2.get_params()
+    blob = np.concatenate([arg_params[k].asnumpy().ravel()
+                           for k in sorted(arg_params)])
+    kv = mx.kv.create("dist_sync")
+    tot = mx.nd.zeros(blob.shape)
+    kv.init("resumecheck", tot)
+    kv.push("resumecheck", mx.nd.array(blob))
+    kv.pull("resumecheck", out=tot)
+    np.testing.assert_allclose(tot.asnumpy(), nproc * blob, rtol=1e-6,
+                               err_msg="resumed replicas diverged")
 
 
 def run_lenet(mx, rank, nproc):

@@ -24,12 +24,14 @@ def _free_port():
     return port
 
 
-def _launch(mode, nproc, timeout=600):
+def _launch(mode, nproc, timeout=600, expect_ranks=None, check_rc=True,
+            extra_env=None):
     env = dict(os.environ)
     # workers must NOT inherit the 8-device virtual mesh of this suite:
     # each is one single-device CPU process in a Gloo ring
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
     worker = os.path.join(ROOT, "tests", "dist_worker.py")
     cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
            "-n", str(nproc), "--coord-port", str(_free_port()),
@@ -37,8 +39,10 @@ def _launch(mode, nproc, timeout=600):
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=timeout)
     out = r.stdout + r.stderr
-    assert r.returncode == 0, out
-    for rank in range(nproc):
+    if check_rc:
+        assert r.returncode == 0, out
+    for rank in (expect_ranks if expect_ranks is not None
+                 else range(nproc)):
         assert "RANK-%d-PASS" % rank in out, out
     return out
 
@@ -58,3 +62,30 @@ def test_dist_lenet_to_accuracy():
     psum path, >=0.95 accuracy on every worker, replicas bitwise consistent
     (ref: dist_lenet.py)."""
     _launch("lenet", 2, timeout=900)
+
+
+def test_dist_sync_kvstore_eight_workers():
+    """BSP semantics at the width the multichip dryrun simulates
+    (ref: dist_sync_kvstore.py run via launch.py -n; VERDICT r4 weak #6)."""
+    _launch("kvstore", 8, timeout=900)
+
+
+def test_dead_worker_detected_by_survivors():
+    """Fault injection: SIGKILL one worker; every survivor must report
+    num_dead_node > 0 within the heartbeat horizon (ref:
+    kvstore_dist.h:159-168 GetDeadNodes; ps-lite heartbeats)."""
+    nproc = 3
+    # the victim (last rank) dies by SIGKILL: launcher exit is nonzero by
+    # design; survivors prove detection via their PASS lines
+    out = _launch("deadworker", nproc, timeout=600, check_rc=False,
+                  expect_ranks=range(nproc - 1))
+    assert "RANK-%d-PASS" % (nproc - 1) not in out, \
+        "victim should never pass"
+
+
+def test_dist_checkpoint_resume_mid_training(tmp_path):
+    """Checkpoint at epoch 3, resume in a fresh module, finish to the
+    accuracy gate with consistent replicas (ref: Module.save_checkpoint /
+    load + --load-epoch, example/image-classification/common/fit.py)."""
+    _launch("resume", 2, timeout=900,
+            extra_env={"MXTPU_TEST_TMPDIR": str(tmp_path)})
