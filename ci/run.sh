@@ -9,6 +9,9 @@ c++ -O2 -std=c++14 -I cpp-package/include cpp-package/example/train_mlp.cpp \
 # C++ LeNet through the generated op wrappers (built by make -C src/capi;
 # run gated on holdout accuracy >= 0.95)
 PYTHONPATH=. JAX_PLATFORMS=cpu ./lib/lenet_cpp
+# Perl XS binding consumes the same ABI (non-C language proof)
+make -C perl-package
+(cd perl-package && PYTHONPATH=.. JAX_PLATFORMS=cpu perl predict.pl)
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/ -q
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -12,7 +12,9 @@
 #ifndef MXNET_TPU_CPP_HPP_
 #define MXNET_TPU_CPP_HPP_
 
+#include <cmath>
 #include <cstdint>
+#include <cstring>
 #include <map>
 #include <stdexcept>
 #include <string>
@@ -361,22 +363,36 @@ class Optimizer {
     } else {
       throw std::runtime_error("Optimizer: unknown type " + type_);
     }
-    std::map<std::string, std::string> p(params_);
+    std::string corrected_lr;  /* storage outlives keys/vals below */
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
     if (type_ == "adam") {
       /* bias correction: like the reference's python/cpp Adam classes,
        * the host passes a corrected lr to the raw adam_update op
        * (ref: python/mxnet/optimizer.py Adam.update) */
       double t = ++counts_[index];
-      double b1 = p.count("beta1") ? std::stod(p["beta1"]) : 0.9;
-      double b2 = p.count("beta2") ? std::stod(p["beta2"]) : 0.999;
-      double lr = p.count("lr") ? std::stod(p["lr"]) : 0.001;
-      lr *= std::sqrt(1.0 - std::pow(b2, t)) / (1.0 - std::pow(b1, t));
-      p["lr"] = std::to_string(lr);
-    }
-    std::vector<const char *> keys, vals;
-    for (auto &kv : p) {
-      keys.push_back(kv.first.c_str());
-      vals.push_back(kv.second.c_str());
+      auto get = [&](const char *k, double dflt) {
+        auto it = params_.find(k);
+        return it == params_.end() ? dflt : std::stod(it->second);
+      };
+      double lr = get("lr", 0.001);
+      lr *= std::sqrt(1.0 - std::pow(get("beta2", 0.999), t)) /
+            (1.0 - std::pow(get("beta1", 0.9), t));
+      corrected_lr = std::to_string(lr);
+      bool replaced = false;
+      for (size_t i = 0; i < keys.size(); i++) {
+        if (strcmp(keys[i], "lr") == 0) {
+          vals[i] = corrected_lr.c_str();
+          replaced = true;
+        }
+      }
+      if (!replaced) {
+        keys.push_back("lr");
+        vals.push_back(corrected_lr.c_str());
+      }
     }
     std::vector<MXTHandle> use{weight->handle(), grad.handle()};
     std::vector<MXTHandle> mut{weight->handle()};

@@ -371,9 +371,8 @@ def MXNDArraySize(handle):
 # C predict API (ref: include/mxnet/c_predict_api.h, src/c_api/
 # c_predict_api.cc — the deploy/amalgamation surface) over Predictor
 # ---------------------------------------------------------------------------
-@_capi
-def MXPredCreate(symbol_json, param_bytes, dev_type, dev_id,
-                 input_keys, input_shapes):
+def _pred_create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
+                 input_shapes, output_names=None):
     from . import dmlc_serial
     from .predictor import Predictor
     from .context import Context
@@ -385,9 +384,42 @@ def MXPredCreate(symbol_json, param_bytes, dev_type, dev_id,
         params = {}
     shapes = {k: tuple(int(d) for d in s)
               for k, s in zip(input_keys, input_shapes)}
-    pred = Predictor(symbol_json, params, shapes, ctx=ctx)
+    pred = Predictor(symbol_json, params, shapes, ctx=ctx,
+                     output_names=output_names)
     pred._pending = {}
     return _new_handle(pred)
+
+
+@_capi
+def MXPredCreate(symbol_json, param_bytes, dev_type, dev_id,
+                 input_keys, input_shapes):
+    return _pred_create(symbol_json, param_bytes, dev_type, dev_id,
+                        input_keys, input_shapes)
+
+
+@_capi
+def MXPredCreatePartialOut(symbol_json, param_bytes, dev_type, dev_id,
+                           input_keys, input_shapes, output_keys):
+    """Predictor over selected output heads (ref: MXPredCreatePartialOut,
+    c_predict_api.h:92-102)."""
+    return _pred_create(symbol_json, param_bytes, dev_type, dev_id,
+                        input_keys, input_shapes,
+                        output_names=list(output_keys))
+
+
+@_capi
+def MXPredReshape(handle, input_keys, input_shapes):
+    """Rebind an existing predictor for new input shapes; returns a NEW
+    predictor handle sharing the loaded weights (the reference's
+    MXPredReshape contract: old handle stays valid)."""
+    import copy as _copy
+    pred = _get(handle)
+    new = _copy.copy(pred)     # shares symbol/params; gets its own executor
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    new.reshape(shapes)
+    new._pending = {}
+    return _new_handle(new)
 
 
 @_capi

@@ -55,7 +55,7 @@ def _strip_loss_heads(symbol):
 
 class Predictor(object):
     def __init__(self, symbol_json_or_file, param_file_or_dict, input_shapes,
-                 ctx=None):
+                 ctx=None, output_names=None):
         ctx = ctx or current_context()
         if isinstance(symbol_json_or_file, str):
             if symbol_json_or_file.lstrip().startswith("{"):
@@ -65,6 +65,20 @@ class Predictor(object):
         else:
             self._symbol = symbol_json_or_file
         self._symbol = _strip_loss_heads(self._symbol)
+        if output_names:
+            # partial-output predictor: bind only the requested heads
+            # (ref: MXPredCreatePartialOut, c_predict_api.h:92-102)
+            internals = self._symbol.get_internals()
+            avail = internals.list_outputs()
+            picked = []
+            for key in output_names:
+                cand = key if key in avail else key + "_output"
+                if cand not in avail:
+                    raise MXNetError(
+                        "partial output %r not found (have e.g. %s)"
+                        % (key, avail[-5:]))
+                picked.append(internals[avail.index(cand)])
+            self._symbol = sym.Group(picked)
         if isinstance(param_file_or_dict, str):
             loaded = nd.load(param_file_or_dict)
         else:
@@ -92,7 +106,42 @@ class Predictor(object):
                                aux_shapes):
             aux[name] = aux_params.get(name, nd.zeros(shape))
         self._input_names = list(input_shapes.keys())
+        self._ctx = ctx
+        self._arg_params = arg_params
+        self._aux_params = aux_params
         self._executor = self._symbol.bind(ctx, args, aux_states=aux)
+
+    def reshape(self, input_shapes):
+        """Rebind for new input shapes, keeping the loaded parameters —
+        the MXPredReshape capability (a predictor serving variable batch
+        sizes without reloading weights). Returns self."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
+        args = {}
+        for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
+            if name in self._arg_params:
+                p = self._arg_params[name]
+                if tuple(p.shape) != tuple(shape):
+                    raise MXNetError(
+                        "reshape changes parameter %s: %s -> %s (only input "
+                        "shapes may change)" % (name, p.shape, shape))
+                args[name] = p
+            else:
+                args[name] = nd.zeros(shape)
+        aux = {}
+        for name, shape in zip(self._symbol.list_auxiliary_states(),
+                               aux_shapes):
+            if name in self._aux_params:
+                a = self._aux_params[name]
+                if tuple(a.shape) != tuple(shape):
+                    raise MXNetError(
+                        "reshape changes auxiliary state %s: %s -> %s (only "
+                        "input shapes may change)" % (name, a.shape, shape))
+                aux[name] = a
+            else:
+                aux[name] = nd.zeros(shape)
+        self._input_names = list(input_shapes.keys())
+        self._executor = self._symbol.bind(self._ctx, args, aux_states=aux)
+        return self
 
     def forward(self, **inputs):
         feed = {}

@@ -1,0 +1,213 @@
+/*
+ * MXNetTPU.xs — minimal Perl binding over the compiled C ABI
+ * (ref role: perl-package/ AI::MXNet, 16.9k LoC of Perl over SWIG glue;
+ * SURVEY.md §2.7). Proves libmxnet_tpu.so is consumable from a non-C
+ * managed language: the Perl consumer (predict.pl) builds a symbol,
+ * binds an executor, and runs inference through these stubs.
+ *
+ * Build: see perl-package/Makefile (xsubpp -> cc -shared).
+ */
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef uint64_t H;
+typedef unsigned int mx_uint;
+
+extern const char *MXGetLastError(void);
+extern int MXGetVersion(int *);
+extern int MXNDArrayCreate(const uint32_t *, uint32_t, int, int, int, H *);
+extern int MXNDArraySyncCopyFromCPU(H, const void *, size_t);
+extern int MXNDArraySyncCopyToCPU(H, void *, size_t);
+extern int MXSymbolCreateVariable(const char *, H *);
+extern int MXSymbolCreateAtomicSymbol(const char *, uint32_t, const char **,
+                                      const char **, H *);
+extern int MXSymbolCompose(H, const char *, uint32_t, const char **, H *);
+extern int MXSymbolListArguments(H, uint32_t *, const char ***);
+extern int MXSymbolListAtomicSymbolCreators(mx_uint *, H **);
+extern int MXExecutorBind(H, int, int, uint32_t, H *, H *, uint32_t, H *,
+                          H *);
+extern int MXExecutorForward(H, int);
+extern int MXExecutorOutputs(H, uint32_t *, H **);
+
+#define PCHK(call)                                                       \
+    do {                                                                 \
+        if ((call) != 0) croak("mxnet_tpu: %s", MXGetLastError());       \
+    } while (0)
+
+/* parse "a,b,c" into uint32 array; returns count */
+static uint32_t parse_csv_u32(const char *s, uint32_t *out, uint32_t cap) {
+    uint32_t n = 0;
+    while (s && *s && n < cap) {
+        out[n++] = (uint32_t)strtoul(s, (char **)&s, 10);
+        if (*s == ',') s++;
+    }
+    return n;
+}
+
+static uint64_t parse_csv_u64(const char *s, H *out, uint32_t cap) {
+    uint32_t n = 0;
+    while (s && *s && n < cap) {
+        out[n++] = (H)strtoull(s, (char **)&s, 10);
+        if (*s == ',') s++;
+    }
+    return n;
+}
+
+MODULE = MXNetTPU  PACKAGE = MXNetTPU
+
+PROTOTYPES: DISABLE
+
+int
+version()
+    CODE:
+        int v = 0;
+        PCHK(MXGetVersion(&v));
+        RETVAL = v;
+    OUTPUT:
+        RETVAL
+
+unsigned int
+op_count()
+    CODE:
+        mx_uint n = 0;
+        H *arr = NULL;
+        PCHK(MXSymbolListAtomicSymbolCreators(&n, &arr));
+        RETVAL = n;
+    OUTPUT:
+        RETVAL
+
+UV
+nd_create(shape_csv)
+        const char *shape_csv
+    CODE:
+        uint32_t shape[8];
+        uint32_t nd = parse_csv_u32(shape_csv, shape, 8);
+        H h = 0;
+        PCHK(MXNDArrayCreate(shape, nd, 1, 0, 0, &h));
+        RETVAL = (UV)h;
+    OUTPUT:
+        RETVAL
+
+void
+nd_set(h, packed)
+        UV h
+        SV *packed
+    CODE:
+        STRLEN len;
+        const char *buf = SvPV(packed, len);
+        PCHK(MXNDArraySyncCopyFromCPU((H)h, buf, len / sizeof(float)));
+
+SV *
+nd_get(h, nfloat)
+        UV h
+        UV nfloat
+    CODE:
+        float *buf = (float *)malloc(nfloat * sizeof(float));
+        int rc = MXNDArraySyncCopyToCPU((H)h, buf, nfloat);
+        if (rc != 0) {
+            free(buf);
+            croak("mxnet_tpu: %s", MXGetLastError());
+        }
+        RETVAL = newSVpvn((const char *)buf, nfloat * sizeof(float));
+        free(buf);
+    OUTPUT:
+        RETVAL
+
+UV
+sym_variable(name)
+        const char *name
+    CODE:
+        H h = 0;
+        PCHK(MXSymbolCreateVariable(name, &h));
+        RETVAL = (UV)h;
+    OUTPUT:
+        RETVAL
+
+UV
+sym_create(op, keys_csv, vals_csv, name, in_csv)
+        const char *op
+        const char *keys_csv
+        const char *vals_csv
+        const char *name
+        const char *in_csv
+    CODE:
+        /* keys/vals as ';'-separated (attr values may contain commas) */
+        const char *keys[16], *vals[16];
+        char kbuf[512], vbuf[512];
+        uint32_t nk = 0;
+        if (keys_csv && *keys_csv) {
+            strncpy(kbuf, keys_csv, sizeof(kbuf) - 1);
+            kbuf[sizeof(kbuf) - 1] = 0;
+            strncpy(vbuf, vals_csv, sizeof(vbuf) - 1);
+            vbuf[sizeof(vbuf) - 1] = 0;
+            char *kp = kbuf, *vp = vbuf;
+            while (kp && vp && nk < 16) {
+                keys[nk] = kp;
+                vals[nk] = vp;
+                nk++;
+                kp = strchr(kp, ';');
+                if (kp) *kp++ = 0;
+                vp = strchr(vp, ';');
+                if (vp) *vp++ = 0;
+            }
+        }
+        H h = 0;
+        PCHK(MXSymbolCreateAtomicSymbol(op, nk, keys, vals, &h));
+        H ins[16];
+        uint32_t ni = (uint32_t)parse_csv_u64(in_csv, ins, 16);
+        PCHK(MXSymbolCompose(h, name, ni, NULL, ins));
+        RETVAL = (UV)h;
+    OUTPUT:
+        RETVAL
+
+SV *
+sym_arguments(h)
+        UV h
+    CODE:
+        uint32_t n = 0;
+        const char **names = NULL;
+        PCHK(MXSymbolListArguments((H)h, &n, &names));
+        SV *joined = newSVpvn("", 0);
+        for (uint32_t i = 0; i < n; i++) {
+            if (i) sv_catpvn(joined, ",", 1);
+            sv_catpv(joined, names[i]);
+        }
+        RETVAL = joined;
+    OUTPUT:
+        RETVAL
+
+UV
+exec_bind(sym, args_csv)
+        UV sym
+        const char *args_csv
+    CODE:
+        H args[64];
+        uint32_t n = (uint32_t)parse_csv_u64(args_csv, args, 64);
+        H ex = 0;
+        PCHK(MXExecutorBind((H)sym, 1, 0, n, args, NULL, 0, NULL, &ex));
+        RETVAL = (UV)ex;
+    OUTPUT:
+        RETVAL
+
+void
+exec_forward(ex)
+        UV ex
+    CODE:
+        PCHK(MXExecutorForward((H)ex, 0));
+
+UV
+exec_out0(ex)
+        UV ex
+    CODE:
+        uint32_t n = 0;
+        H *outs = NULL;
+        PCHK(MXExecutorOutputs((H)ex, &n, &outs));
+        if (n < 1) croak("mxnet_tpu: executor has no outputs");
+        RETVAL = (UV)outs[0];
+    OUTPUT:
+        RETVAL

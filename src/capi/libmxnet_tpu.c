@@ -49,8 +49,12 @@ static void set_err_from_py(void) {
 /* Initialize the interpreter + import mxnet_tpu.c_api once.
  * Mutex-guarded: concurrent first calls from multiple client threads must
  * not double-run Py_InitializeEx/PyEval_SaveThread. */
+#include <dlfcn.h>
 #include <pthread.h>
 static pthread_mutex_t g_init_lock = PTHREAD_MUTEX_INITIALIZER;
+
+#define MXTPU_STR2(x) #x
+#define MXTPU_STR(x) MXTPU_STR2(x)
 
 static int ensure_init(void) {
     if (g_capi) return 0;
@@ -60,6 +64,18 @@ static int ensure_init(void) {
         return 0;
     }
     if (!Py_IsInitialized()) {
+        /* when THIS library was dlopen'd by a foreign host (Perl, R, Lua),
+         * libpython's symbols are not in the global namespace and python's
+         * own extension modules (math, _struct, numpy) fail to resolve
+         * them — promote libpython to RTLD_GLOBAL first */
+        const char *pylibs[] = {
+            "libpython" MXTPU_STR(PY_MAJOR_VERSION) "."
+                MXTPU_STR(PY_MINOR_VERSION) ".so.1.0",
+            "libpython" MXTPU_STR(PY_MAJOR_VERSION) "."
+                MXTPU_STR(PY_MINOR_VERSION) ".so",
+            NULL};
+        for (int i = 0; pylibs[i]; i++)
+            if (dlopen(pylibs[i], RTLD_NOW | RTLD_GLOBAL)) break;
         Py_InitializeEx(0);
         /* release the GIL so PyGILState_Ensure works from any thread */
         PyEval_SaveThread();
@@ -522,6 +538,68 @@ MXTPU_EXPORT int MXPredCreate(const char *symbol_json,
     PyObject *v = capi_call("MXPredCreate",
                             Py_BuildValue("(sNiiNN)", symbol_json, pb,
                                           dev_type, dev_id, pk, ps));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* CSR key/shape marshalling shared by the MXPred* entry points: fills
+ * *out_keys / *out_shapes with new refs (call under the GIL) */
+static void pred_keys_shapes(uint32_t n, const char **keys,
+                             const uint32_t *indptr, const uint32_t *data,
+                             PyObject **out_keys, PyObject **out_shapes) {
+    PyObject *pk = PyList_New(n), *ps = PyList_New(n);
+    for (uint32_t i = 0; i < n; i++) {
+        PyList_SetItem(pk, i, PyUnicode_FromString(keys[i]));
+        uint32_t b = indptr[i], e = indptr[i + 1];
+        PyObject *shape = PyTuple_New(e - b);
+        for (uint32_t j = b; j < e; j++)
+            PyTuple_SetItem(shape, j - b, PyLong_FromUnsignedLong(data[j]));
+        PyList_SetItem(ps, i, shape);
+    }
+    *out_keys = pk;
+    *out_shapes = ps;
+}
+
+MXTPU_EXPORT int MXPredCreatePartialOut(
+    const char *symbol_json, const void *param_bytes, int param_size,
+    int dev_type, int dev_id, uint32_t num_input_nodes,
+    const char **input_keys, const uint32_t *input_shape_indptr,
+    const uint32_t *input_shape_data, uint32_t num_output_nodes,
+    const char **output_keys, PredictorHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pk, *ps;
+    pred_keys_shapes(num_input_nodes, input_keys, input_shape_indptr,
+                     input_shape_data, &pk, &ps);
+    PyObject *po = PyList_New(num_output_nodes);
+    for (uint32_t i = 0; i < num_output_nodes; i++)
+        PyList_SetItem(po, i, PyUnicode_FromString(output_keys[i]));
+    PyObject *pb = PyBytes_FromStringAndSize(
+        (const char *)param_bytes, param_size);
+    PyObject *v = capi_call("MXPredCreatePartialOut",
+                            Py_BuildValue("(sNiiNNN)", symbol_json, pb,
+                                          dev_type, dev_id, pk, ps, po));
+    int rc = -1;
+    if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
+    PyGILState_Release(st);
+    return rc;
+}
+
+MXTPU_EXPORT int MXPredReshape(uint32_t num_input_nodes,
+                               const char **input_keys,
+                               const uint32_t *input_shape_indptr,
+                               const uint32_t *input_shape_data,
+                               PredictorHandle handle,
+                               PredictorHandle *out) {
+    ENSURE();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *pk, *ps;
+    pred_keys_shapes(num_input_nodes, input_keys, input_shape_indptr,
+                     input_shape_data, &pk, &ps);
+    PyObject *v = capi_call("MXPredReshape",
+                            Py_BuildValue("(KNN)", handle, pk, ps));
     int rc = -1;
     if (v) { *out = PyLong_AsUnsignedLongLong(v); Py_DECREF(v); rc = 0; }
     PyGILState_Release(st);

@@ -21,6 +21,9 @@ extern int MXPredForward(PredictorHandle);
 extern int MXPredGetOutputShape(PredictorHandle, uint32_t, uint32_t **,
                                 uint32_t *);
 extern int MXPredGetOutput(PredictorHandle, uint32_t, float *, uint32_t);
+extern int MXPredReshape(uint32_t, const char **, const uint32_t *,
+                         const uint32_t *, PredictorHandle,
+                         PredictorHandle *);
 extern int MXPredFree(PredictorHandle);
 
 #define CHK(c)                                                       \
@@ -87,6 +90,41 @@ int main(int argc, char **argv) {
             if (out[i * classes + c] > out[i * classes + best]) best = c;
         printf("row %u argmax %u\n", i, best);
     }
+    /* reshape to double the batch WITHOUT recreating the predictor
+     * (ref capability: MXPredReshape — weights are not reloaded) */
+    uint32_t batch2 = batch * 2;
+    uint32_t shape2[] = {batch2, feat};
+    PredictorHandle h2;
+    CHK(MXPredReshape(1, keys, indptr, shape2, h, &h2));
+    float *x2 = malloc(sizeof(float) * batch2 * feat);
+    for (uint32_t i = 0; i < batch2 * feat; i++)
+        x2[i] = x[i % (batch * feat)];
+    CHK(MXPredSetInput(h2, "data", x2, batch2 * feat));
+    CHK(MXPredForward(h2));
+    uint32_t *oshape2, ondim2;
+    CHK(MXPredGetOutputShape(h2, 0, &oshape2, &ondim2));
+    if (oshape2[0] != batch2) {
+        fprintf(stderr, "reshape batch wrong: %u != %u\n", oshape2[0],
+                batch2);
+        return 1;
+    }
+    uint32_t osize2 = osize * 2;
+    float *out2 = malloc(sizeof(float) * osize2);
+    CHK(MXPredGetOutput(h2, 0, out2, osize2));
+    /* duplicated rows through shared weights must reproduce row outputs */
+    for (uint32_t i = 0; i < osize; i++) {
+        float d = out2[i] - out[i];
+        if (d < 0) d = -d;
+        if (d > 1e-5f) {
+            fprintf(stderr, "reshape output mismatch at %u\n", i);
+            return 1;
+        }
+    }
+    /* the ORIGINAL predictor must stay usable after reshape */
+    CHK(MXPredSetInput(h, "data", x, batch * feat));
+    CHK(MXPredForward(h));
+    printf("RESHAPE PASS\n");
+    CHK(MXPredFree(h2));
     CHK(MXPredFree(h));
     printf("PREDICT PASS\n");
     return 0;
