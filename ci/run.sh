@@ -15,4 +15,11 @@ make -C perl-package
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/ -q
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+# chip stage: hard convergence gates + the ImageNet recipe compile-check
+# (uses the real TPU when attached; tools default to the ambient platform).
+# The full-size gate (defaults: 2400 imgs, 6 epochs) passes too but takes
+# ~27 min on a 1-core host; CI runs the mid-size config.
+python tools/convergence_gate_realdata.py \
+    --n-per-class 100 --epochs 5 --min-acc 0.9
+python example/image-classification/train_imagenet.py --validate-recipe
 echo "CI PASS"

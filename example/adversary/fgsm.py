@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Adversarial examples via FGSM — the autograd showcase (ref:
+example/adversary/adversary_generation.ipynb: train a net, take the
+gradient of the loss W.R.T. THE INPUT, perturb by eps*sign(grad), watch
+accuracy collapse).
+
+The input gradient comes from binding the executor with a grad array for
+``data`` — grad_req on data, the same mechanism the reference notebook
+uses.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def build_net(n_class):
+    data = sym.Variable("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=48, name="fc1"),
+                       act_type="relu")
+    fc2 = sym.FullyConnected(h, num_hidden=n_class, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def main(num_epoch=4, batch=64, eps=1.0):
+    rng = np.random.RandomState(0)
+    n_class, dim = 5, 16
+    # moderate margins: a fully-saturated softmax has exactly-zero f32
+    # input gradients and FGSM has no direction to follow
+    templates = rng.randn(n_class, dim).astype(np.float32) * 1.2
+    labels = np.arange(n_class * 80) % n_class
+    X = templates[labels] + rng.randn(len(labels), dim).astype(np.float32) * .3
+    y = labels.astype(np.float32)
+
+    net = build_net(n_class)
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=True)
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+
+    # bind an executor WITH a gradient array on data (grad_req includes
+    # the input), then FGSM: x_adv = x + eps * sign(dL/dx)
+    args = {"data": nd.array(X[:batch]),
+            "softmax_label": nd.array(y[:batch])}
+    args.update({k: v for k, v in arg_params.items()})
+    grads = {"data": nd.zeros((batch, dim))}
+    exe = net.bind(mx.cpu(), args, args_grad=grads, grad_req="write",
+                   aux_states=aux_params)
+
+    def batch_acc(xb, yb):
+        exe.arg_dict["data"][:] = xb
+        exe.forward(is_train=False)
+        pred = exe.outputs[0].asnumpy().argmax(axis=1)
+        return float((pred == yb).mean())
+
+    clean_acc, adv_acc, n = 0.0, 0.0, 0
+    for s in range(0, len(X) - batch + 1, batch):
+        xb, yb = X[s:s + batch], y[s:s + batch]
+        exe.arg_dict["data"][:] = xb
+        exe.arg_dict["softmax_label"][:] = yb
+        exe.forward(is_train=True)
+        exe.backward()
+        gsign = np.sign(exe.grad_dict["data"].asnumpy())
+        clean_acc += batch_acc(xb, yb)
+        adv_acc += batch_acc(xb + eps * gsign, yb)
+        n += 1
+    clean_acc /= n
+    adv_acc /= n
+    print("clean accuracy %.3f -> FGSM(eps=%.2f) accuracy %.3f"
+          % (clean_acc, eps, adv_acc))
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=4)
+    ap.add_argument("--eps", type=float, default=1.0)
+    args = ap.parse_args()
+    clean, adv = main(args.num_epoch, eps=args.eps)
+    if clean < 0.95:
+        raise SystemExit("FAIL: clean accuracy %.3f < 0.95" % clean)
+    if adv > clean - 0.3:
+        raise SystemExit("FAIL: FGSM did not degrade accuracy "
+                         "(%.3f -> %.3f)" % (clean, adv))
+    print("ADVERSARY PASS")

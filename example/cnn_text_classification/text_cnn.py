@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""CNN for sentence classification — Kim (2014) architecture: word
+embedding, parallel convolutions of several filter widths, max-over-time
+pooling, concat, dropout, FC (ref: example/cnn_text_classification/
+text_cnn.py). Synthetic corpus: the class is determined by which trigram
+pattern appears somewhere in the sentence — exactly the signal width-3
+filters detect.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def build_net(vocab_size, num_embed, seq_len, filter_widths, num_filter,
+              n_class, dropout=0.25):
+    data = sym.Variable("data")                    # (B, seq_len) token ids
+    embed = sym.Embedding(data, input_dim=vocab_size, output_dim=num_embed,
+                          name="embed")            # (B, T, E)
+    conv_in = sym.Reshape(embed, shape=(-1, 1, seq_len, num_embed))
+    pooled = []
+    for w in filter_widths:
+        conv = sym.Convolution(conv_in, kernel=(w, num_embed),
+                               num_filter=num_filter, name="conv%d" % w)
+        act = sym.Activation(conv, act_type="relu")
+        pool = sym.Pooling(act, kernel=(seq_len - w + 1, 1),
+                           pool_type="max", name="pool%d" % w)
+        pooled.append(pool)
+    concat = sym.Concat(*pooled, dim=1)
+    flat = sym.Flatten(concat)
+    drop = sym.Dropout(flat, p=dropout)
+    fc = sym.FullyConnected(drop, num_hidden=n_class, name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def make_corpus(n_sent, seq_len, vocab_size, n_class, rng):
+    """class c <=> trigram (c+1, c+2, c+3) planted at a random position."""
+    X = rng.randint(10, vocab_size, size=(n_sent, seq_len))
+    y = rng.randint(0, n_class, size=n_sent)
+    for i in range(n_sent):
+        pos = rng.randint(0, seq_len - 3)
+        X[i, pos:pos + 3] = [y[i] + 1, y[i] + 2, y[i] + 3]
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def main(num_epoch=8, batch=32):
+    rng = np.random.RandomState(3)
+    vocab_size, num_embed, seq_len, n_class = 40, 16, 12, 4
+    X, y = make_corpus(640, seq_len, vocab_size, n_class, rng)
+    Xv, yv = make_corpus(160, seq_len, vocab_size, n_class, rng)
+
+    net = build_net(vocab_size, num_embed, seq_len, (3, 4), 16, n_class)
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=True)
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=batch)
+    mod.fit(it, num_epoch=num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": 0.005},
+            initializer=mx.initializer.Xavier())
+    acc = mod.score(val, mx.metric.Accuracy())[0][1]
+    print("text-cnn holdout accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=8)
+    args = ap.parse_args()
+    acc = main(args.num_epoch)
+    if acc < 0.9:
+        raise SystemExit("FAIL: accuracy %.3f < 0.9" % acc)
+    print("TEXT-CNN PASS")

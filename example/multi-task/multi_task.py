@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Multi-task training: one shared body, two softmax heads trained jointly
+(ref: example/multi-task/example_multi_task.py — digit + parity heads over
+one MNIST body, sym.Group of two SoftmaxOutputs, per-task metrics).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+class MultiTaskIter(mx.io.DataIter):
+    """Wraps NDArrayIter, serving TWO labels per batch (class + parity)."""
+
+    def __init__(self, X, y, batch_size):
+        super().__init__(batch_size)
+        self._it = mx.io.NDArrayIter(X, y, batch_size=batch_size)
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        (name, shape), = self._it.provide_label
+        return [("softmax1_label", shape), ("softmax2_label", shape)]
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        b = self._it.next()
+        cls = b.label[0]
+        parity = mx.nd.array(cls.asnumpy() % 2)
+        return mx.io.DataBatch(data=b.data, label=[cls, parity],
+                               pad=b.pad, index=b.index)
+
+
+def build_net(n_class):
+    data = sym.Variable("data")
+    body = sym.Activation(
+        sym.FullyConnected(data, num_hidden=64, name="fc_body"),
+        act_type="relu")
+    head1 = sym.SoftmaxOutput(
+        sym.FullyConnected(body, num_hidden=n_class, name="fc1"),
+        name="softmax1")
+    head2 = sym.SoftmaxOutput(
+        sym.FullyConnected(body, num_hidden=2, name="fc2"),
+        name="softmax2")
+    return sym.Group([head1, head2])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy (ref: the example's Multi_Accuracy; EvalMetric's
+    num= gives the per-task accumulator lists)."""
+
+    def __init__(self, num=2):
+        super().__init__("multi-accuracy", num=num)
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            label = labels[i].asnumpy().astype(np.int64)
+            self.sum_metric[i] += float((pred == label).sum())
+            self.num_inst[i] += len(label)
+
+    def get(self):
+        accs = [s / max(n, 1)
+                for s, n in zip(self.sum_metric, self.num_inst)]
+        return (["task%d-acc" % i for i in range(self.num)], accs)
+
+
+def main(num_epoch=12, batch=32):
+    rng = np.random.RandomState(0)
+    n_class, dim = 6, 24
+    templates = rng.randn(n_class, dim).astype(np.float32) * 2
+    labels = np.arange(n_class * 64) % n_class
+    X = templates[labels] + rng.randn(len(labels), dim).astype(np.float32) * .4
+    y = labels.astype(np.float32)
+
+    net = build_net(n_class)
+    mod = mx.mod.Module(net, label_names=("softmax1_label",
+                                          "softmax2_label"))
+    it = MultiTaskIter(X, y, batch)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.2})
+    metric = MultiAccuracy()
+    for epoch in range(num_epoch):
+        it.reset()
+        metric.reset()
+        for b in it:
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+            metric.update(b.label, mod.get_outputs())
+    names, accs = metric.get()
+    print("multi-task:", dict(zip(names, [round(a, 3) for a in accs])))
+    return accs
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=12)
+    args = ap.parse_args()
+    accs = main(args.num_epoch)
+    if min(accs) < 0.95:
+        raise SystemExit("FAIL: accuracies %r below 0.95" % accs)
+    print("MULTI-TASK PASS")

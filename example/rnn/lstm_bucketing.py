@@ -31,6 +31,8 @@ def main():
     parser.add_argument("--num-epochs", type=int, default=10)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--ppl-gate", type=float, default=None,
+                        help="fail unless final train perplexity <= gate")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -129,6 +131,12 @@ def main():
             mod.update()
             mod.update_metric(metric, b.label)
         logging.info("Epoch[%d] Train-%s=%f", epoch, *metric.get())
+    if args.ppl_gate is not None:
+        name, ppl = metric.get()
+        if not ppl <= args.ppl_gate:
+            raise SystemExit("PPL GATE FAIL: %.3f > %.3f"
+                             % (ppl, args.ppl_gate))
+        print("PPL PASS %.3f <= %.3f" % (ppl, args.ppl_gate))
 
 
 if __name__ == "__main__":

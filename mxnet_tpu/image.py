@@ -31,8 +31,17 @@ def _open_sharded_record(path_imgrec, part_index=0, num_parts=1):
     idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
     rec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
     seq = list(rec.keys)
+    if not seq:
+        # an un-indexed .rec otherwise iterates as zero batches — silent
+        raise MXNetError(
+            "no records indexed for %r: missing or empty %s (pack with "
+            "MXIndexedRecordIO / tools/im2rec.py)" % (path_imgrec, idx_path))
     if num_parts > 1:
         n = len(seq) // num_parts
+        if n == 0:
+            raise MXNetError(
+                "%r has %d records, fewer than num_parts=%d: every shard "
+                "would be empty" % (path_imgrec, len(seq), num_parts))
         seq = seq[part_index * n:(part_index + 1) * n]
     return rec, seq
 

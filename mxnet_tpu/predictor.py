@@ -114,7 +114,17 @@ class Predictor(object):
     def reshape(self, input_shapes):
         """Rebind for new input shapes, keeping the loaded parameters —
         the MXPredReshape capability (a predictor serving variable batch
-        sizes without reloading weights). Returns self."""
+        sizes without reloading weights). Inputs not named keep their
+        current shapes (the reference allows partial reshape). Returns
+        self."""
+        full = {n: tuple(self._executor.arg_dict[n].shape)
+                for n in self._input_names}
+        unknown = set(input_shapes) - set(full)
+        if unknown:
+            raise MXNetError("reshape: unknown inputs %s (have %s)"
+                             % (sorted(unknown), self._input_names))
+        full.update({k: tuple(v) for k, v in input_shapes.items()})
+        input_shapes = full
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
         args = {}
         for name, shape in zip(self._symbol.list_arguments(), arg_shapes):

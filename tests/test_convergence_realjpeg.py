@@ -1,0 +1,24 @@
+"""Suite-sized run of the real-JPEG convergence gate: 10-class generated
+JPEG dataset through the native decode/augment pipeline, multi-epoch with
+an LR schedule, held-out accuracy gate (ref: tests/nightly/test_all.sh
+check_val; the full-size gate runs in ci/run.sh's chip stage)."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_realjpeg_convergence_gate_small():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "convergence_gate_realdata.py"),
+         "--classes", "10", "--n-per-class", "60", "--size", "40",
+         "--crop", "32", "--batch", "50", "--epochs", "5",
+         "--min-acc", "0.85"],
+        capture_output=True, text=True, timeout=1500, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REALDATA CONVERGENCE PASS" in r.stdout

@@ -20,6 +20,12 @@ def test_perl_consumer_runs_inference():
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    if not os.path.exists(os.path.join(ROOT, "lib", "libmxnet_tpu.so")):
+        r = subprocess.run(["make", "-C",
+                            os.path.join(ROOT, "src", "capi")],
+                           capture_output=True, text=True, timeout=600,
+                           env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
     r = subprocess.run(["make", "-C", PKG], capture_output=True, text=True,
                        timeout=300, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
