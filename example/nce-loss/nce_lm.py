@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Noise-contrastive estimation for a large-softmax skip-gram-style model
+(ref: example/nce-loss/{nce.py,wordvec.py} — train word embeddings against
+sampled negatives instead of the full softmax).
+
+The NCE head is built from existing symbols: the label's embedding row and
+K sampled-noise rows are scored against the context vector with
+LogisticRegressionOutput targets 1/0 (the reference composes its nce head
+the same way from Embedding + dot + logistic loss).
+
+Synthetic corpus: token t co-occurs with (t+1) mod V; after training, the
+true successor must outscore random tokens almost always.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def build_net(vocab, dim, k_noise):
+    data = sym.Variable("data")            # (B,) center token
+    cand = sym.Variable("cand")            # (B, 1+K) [true, noise...]
+    label = sym.Variable("nce_label")      # (B, 1+K) [1, 0...]
+    in_emb = sym.Embedding(data, input_dim=vocab, output_dim=dim,
+                           name="in_emb")              # (B, D)
+    out_emb = sym.Embedding(cand, input_dim=vocab, output_dim=dim,
+                            name="out_emb")            # (B, 1+K, D)
+    ctx = sym.Reshape(in_emb, shape=(-1, 1, dim))      # (B, 1, D)
+    scores = sym.sum(sym.broadcast_mul(out_emb, ctx), axis=2)  # (B, 1+K)
+    return sym.LogisticRegressionOutput(data=scores, label=label,
+                                        name="nce")
+
+
+def main(num_epoch=12, batch=64):
+    rng = np.random.RandomState(0)
+    vocab, dim, k_noise = 50, 16, 8
+    n = 4096
+    centers = rng.randint(0, vocab, n)
+    true_next = (centers + 1) % vocab
+    cand = np.concatenate(
+        [true_next[:, None], rng.randint(0, vocab, (n, k_noise))], axis=1)
+    labels = np.zeros((n, 1 + k_noise), np.float32)
+    labels[:, 0] = 1.0
+
+    it = mx.io.NDArrayIter(
+        {"data": centers.astype(np.float32), "cand": cand.astype(np.float32)},
+        {"nce_label": labels}, batch_size=batch, shuffle=True)
+    net = build_net(vocab, dim, k_noise)
+    mod = mx.mod.Module(net, data_names=("data", "cand"),
+                        label_names=("nce_label",))
+    mod.fit(it, num_epoch=num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.initializer.Normal(0.1))
+
+    # eval: true successor must outscore a random non-successor
+    arg_params, _ = mod.get_params()
+    W_in = arg_params["in_emb_weight"].asnumpy()
+    W_out = arg_params["out_emb_weight"].asnumpy()
+    test_c = rng.randint(0, vocab, 512)
+    pos = (test_c + 1) % vocab
+    neg = (test_c + 1 + rng.randint(1, vocab - 1, 512)) % vocab
+    s_pos = (W_in[test_c] * W_out[pos]).sum(1)
+    s_neg = (W_in[test_c] * W_out[neg]).sum(1)
+    acc = float((s_pos > s_neg).mean())
+    print("nce ranking accuracy (true vs random): %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=12)
+    args = ap.parse_args()
+    acc = main(args.num_epoch)
+    if acc < 0.95:
+        raise SystemExit("FAIL: ranking accuracy %.3f < 0.95" % acc)
+    print("NCE PASS")

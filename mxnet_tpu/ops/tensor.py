@@ -96,6 +96,10 @@ register_binary("_minus", jnp.subtract)
 register_binary("broadcast_plus", jnp.add)
 register_binary("broadcast_minus", jnp.subtract)
 register_binary("_grad_add", jnp.add)
+# public maximum/minimum: the reference exposes mx.nd.maximum(lhs, rhs)
+# delegating to broadcast_maximum (ref: python/mxnet/ndarray.py:1497)
+register_binary("maximum", jnp.maximum)
+register_binary("minimum", jnp.minimum)
 
 for _n, _f in _BINARY.items():
     register_binary_scalar("_%s_scalar" % _n, _f)

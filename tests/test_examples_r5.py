@@ -28,6 +28,9 @@ def _run(relpath, *args, timeout=900):
     ("example/multi-task/multi_task.py", "MULTI-TASK PASS"),
     ("example/cnn_text_classification/text_cnn.py", "TEXT-CNN PASS"),
     ("example/adversary/fgsm.py", "ADVERSARY PASS"),
+    ("example/recommenders/matrix_fact.py", "RECOMMENDER PASS"),
+    ("example/nce-loss/nce_lm.py", "NCE PASS"),
+    ("example/reinforcement-learning/reinforce.py", "RL PASS"),
 ])
 def test_example_passes(relpath, marker):
     out = _run(relpath)
