@@ -1097,10 +1097,20 @@ def MXKVStoreSendCommmandToServers(handle, cmd_id, cmd_body):
     kv = _get(handle)
     if int(cmd_id) == 0:  # kController optimizer install (ref: kvstore.py:226)
         import pickle
+        body = bytes(cmd_body) if not isinstance(cmd_body, str) \
+            else cmd_body.encode("latin-1")
         try:
-            kv.set_optimizer(pickle.loads(bytes(cmd_body)))
-        except Exception:
-            pass  # non-pickle body: command is advisory on this substrate
+            optzr = pickle.loads(body)
+        except Exception as e:
+            # a body that fails to unpickle means the server would train
+            # with the WRONG optimizer — surface it, never swallow it
+            # (the truncation bug this catches: NUL-terminated marshalling
+            # of a binary pickle; use MXKVStoreSendCommmandToServersEx)
+            raise MXNetError(
+                "kvstore command 0 (set optimizer): body of %d bytes "
+                "failed to unpickle (%s: %s); binary bodies must be sent "
+                "length-explicit" % (len(body), type(e).__name__, e))
+        kv.set_optimizer(optzr)
     # other commands (kSetMultiPrecision etc.) have no role here
 
 

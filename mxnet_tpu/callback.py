@@ -29,8 +29,16 @@ def do_checkpoint(prefix, period=1):
 
 def log_train_metric(period, auto_reset=False):
     """Log metric every ``period`` batches (ref: callback.py)."""
+    last = [-1]  # nbatch at the last fire; -1 keeps batch 0's fire
+
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
+        # nbatch arrives in K-batch jumps under steps_per_dispatch, so fire
+        # on crossing each period boundary, like Speedometer
+        if param.nbatch < last[0]:
+            last[0] = -1  # epoch restarted
+        if param.nbatch // period > last[0] // period \
+                and param.eval_metric is not None:
+            last[0] = param.nbatch
             name_value = param.eval_metric.get_name_value()
             for name, value in name_value:
                 logging.info("Iter[%d] Batch[%d] Train-%s=%f",
@@ -49,6 +57,7 @@ class Speedometer(object):
         self.init = False
         self.tic = 0
         self.last_count = 0
+        self._fired = 0
 
     def __call__(self, param):
         count = param.nbatch
@@ -56,8 +65,13 @@ class Speedometer(object):
             self.init = False
         self.last_count = count
         if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+            # batch_end arrives in K-batch jumps under steps_per_dispatch
+            # (docs/perf.md "Dispatch bulking"), so fire on CROSSING each
+            # `frequent` boundary — never on exact equality — and scale the
+            # speed by the true batch delta since the last fire
+            if count // self.frequent > self._fired // self.frequent:
+                speed = ((count - self._fired) * self.batch_size
+                         / (time.time() - self.tic))
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     param.eval_metric.reset()
@@ -69,9 +83,11 @@ class Speedometer(object):
                 else:
                     logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                                  param.epoch, count, speed)
+                self._fired = count
                 self.tic = time.time()
         else:
             self.init = True
+            self._fired = count
             self.tic = time.time()
 
 

@@ -12,14 +12,20 @@ remains useful — and is kept here — is the *control* surface:
 - naive/synchronous debug mode (ref: MXNET_ENGINE_TYPE=NaiveEngine) which
   forces a blocking wait after every imperative op, for bisecting async bugs.
 - ``push(fn)`` for host callbacks ordered after all pending device work.
+- ``bulk(k)`` dispatch bulking (ref: Engine bulk execution /
+  MXEngineSetBulkSize): on this substrate the bulked unit is K whole train
+  steps compiled into one ``lax.scan`` dispatch — ``Module.fit`` reads the
+  current bulk size as its default ``steps_per_dispatch``.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 
 import jax
 
 _naive = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+_bulk_steps = int(os.environ.get("MXTPU_BULK_STEPS", "1") or 1)
 
 
 def set_engine_type(name):
@@ -31,6 +37,32 @@ def set_engine_type(name):
 
 def is_naive():
     return _naive
+
+
+def set_bulk_size(size):
+    """Set the default steps-per-dispatch for training loops; returns the
+    previous value (ref: Engine::set_bulk_size)."""
+    global _bulk_steps
+    prev = _bulk_steps
+    _bulk_steps = max(1, int(size))
+    return prev
+
+
+def bulk_size():
+    """Current default steps-per-dispatch consumed by Module.fit."""
+    return _bulk_steps
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scoped dispatch bulking: ``with mx.engine.bulk(8): mod.fit(...)``
+    trains 8 steps per compiled dispatch (the reference's engine bulk
+    scope, applied at train-loop granularity)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
 
 
 def maybe_sync(arr):

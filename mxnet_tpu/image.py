@@ -263,7 +263,11 @@ class ImageIter(mxio.DataIter):
         img = np.transpose(img.astype(np.float32), (2, 0, 1))
         return img, label
 
-    def next(self):
+    def next_host(self):
+        """One batch as host numpy (no device transfer). This is the
+        superbatch hook: ``io.SuperBatchIter`` stacks K of these on its
+        prefetch thread and lands the whole (k, batch, ...) stack on device
+        as ONE H2D transfer."""
         if self.cur + self.batch_size > len(self.seq):
             raise StopIteration
         data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
@@ -274,8 +278,14 @@ class ImageIter(mxio.DataIter):
             labels[i] = np.asarray(label, np.float32).reshape(-1)[:self.label_width]
         self.cur += self.batch_size
         label_arr = labels[:, 0] if self.label_width == 1 else labels
-        return mxio.DataBatch(data=[array(data)], label=[array(label_arr)],
+        return mxio.DataBatch(data=[data], label=[label_arr],
                               pad=0, index=None)
+
+    def next(self):
+        batch = self.next_host()
+        return mxio.DataBatch(data=[array(a) for a in batch.data],
+                              label=[array(a) for a in batch.label],
+                              pad=batch.pad, index=None)
 
 
 # ---------------------------------------------------------------------------

@@ -61,6 +61,14 @@ class DataIter(object):
     def __iter__(self):
         return self
 
+    def superbatch(self, k, prefetch=True, **kwargs):
+        """Bulk this iterator for K-steps-per-dispatch training: returns a
+        :class:`SuperBatchIter` that stacks K consecutive batches into one
+        (k, batch, ...) superbatch, assembled and landed on device by a
+        prefetch thread. Feeds ``TrainStep.run_steps`` /
+        ``Module.fit(steps_per_dispatch=k)``."""
+        return SuperBatchIter(self, k, prefetch=prefetch, **kwargs)
+
     def reset(self):
         pass
 
@@ -256,6 +264,224 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class SuperDataBatch(DataBatch):
+    """K stacked mini-batches: every array carries a leading (k,) step axis.
+
+    ``num_steps`` may be smaller than the configured K for the epoch tail;
+    consumers that compiled for a fixed K should route such a tail through
+    :meth:`unstack` (per-step views) instead of compiling a second scan.
+    """
+
+    def __init__(self, data, label=None, pads=None, num_steps=1,
+                 provide_data=None, provide_label=None):
+        pads = list(pads) if pads is not None else [0] * num_steps
+        super().__init__(data, label=label, pad=pads[-1] if pads else 0,
+                         provide_data=provide_data,
+                         provide_label=provide_label)
+        self.num_steps = num_steps
+        self.pads = pads
+
+    def unstack(self):
+        """Per-step DataBatch views (on-device slices along the step axis)."""
+        out = []
+        for i in range(self.num_steps):
+            out.append(DataBatch(
+                data=[a[i] for a in self.data],
+                label=[a[i] for a in (self.label or [])],
+                pad=self.pads[i] if i < len(self.pads) else 0))
+        return out
+
+
+class SuperBatchIter(DataIter):
+    """Device-resident batch queue for multi-step dispatch.
+
+    Pulls K consecutive batches from ``base``, stacks them host-side into one
+    (k, batch, ...) superbatch and lands it on device as ONE H2D transfer —
+    all on a producer thread, with ``queue_depth`` superbatches in flight so
+    the transfer of superbatch n+1 overlaps the K-step scan of superbatch n
+    (the ``iter_prefetcher.h`` role, one level up: the unit in flight is a
+    whole dispatch, not a batch).
+
+    When ``base`` exposes ``next_host()`` (host-numpy batches, e.g.
+    ``image.ImageIter``) stacking happens before any device transfer; batches
+    that are already device-resident are stacked with ``jnp.stack`` instead.
+    The epoch tail (fewer than K batches left) is yielded as a partial
+    superbatch with ``num_steps < k``, or dropped with
+    ``last_group_handle='discard'``.
+    """
+
+    def __init__(self, base, k, prefetch=True, queue_depth=2,
+                 last_group_handle="partial"):
+        super().__init__(getattr(base, "batch_size", 0))
+        if k < 1:
+            raise MXNetError("superbatch: k must be >= 1, got %r" % (k,))
+        if last_group_handle not in ("partial", "discard"):
+            raise MXNetError("superbatch: last_group_handle must be "
+                             "'partial' or 'discard'")
+        self.base = base
+        self.k = int(k)
+        self.last_group_handle = last_group_handle
+        self._prefetch = prefetch
+        self._depth = max(1, int(queue_depth))
+        self._queue = None
+        self._thread = None
+        self._stop = None
+        self._done = False
+        if prefetch:
+            self._start_producer()
+
+    def _stacked_descs(self, descs):
+        # legacy (name, shape) tuple descriptors are accepted everywhere
+        # DataDesc is (executor_group, module) — here too
+        out = []
+        for d in descs:
+            if hasattr(d, "name"):
+                out.append(DataDesc(d.name, (self.k,) + tuple(d.shape),
+                                    d.dtype))
+            else:
+                out.append(DataDesc(d[0], (self.k,) + tuple(d[1])))
+        return out
+
+    @property
+    def provide_data(self):
+        return self._stacked_descs(self.base.provide_data)
+
+    @property
+    def provide_label(self):
+        return self._stacked_descs(self.base.provide_label)
+
+    # -- assembly ------------------------------------------------------
+    def _pull_group(self):
+        group = []
+        next_host = getattr(self.base, "next_host", None)
+        for _ in range(self.k):
+            try:
+                group.append(next_host() if next_host is not None
+                             else self.base.next())
+            except StopIteration:
+                break
+        if not group or (len(group) < self.k
+                         and self.last_group_handle == "discard"):
+            return None
+        return group
+
+    @staticmethod
+    def _stack(parts):
+        """One stacked array per slot; host parts take a single np.stack +
+        device put (ONE H2D for the whole superbatch slot), device parts
+        stack on device."""
+        raw = [p.data if isinstance(p, NDArray) else p for p in parts]
+        if all(isinstance(r, np.ndarray) for r in raw):
+            return array(np.stack(raw))
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([jnp.asarray(r) for r in raw]))
+
+    def _assemble(self, group):
+        n_data = len(group[0].data)
+        n_label = len(group[0].label or [])
+        data = [self._stack([b.data[i] for b in group])
+                for i in range(n_data)]
+        label = [self._stack([b.label[i] for b in group])
+                 for i in range(n_label)]
+        return SuperDataBatch(
+            data=data, label=label, pads=[b.pad or 0 for b in group],
+            num_steps=len(group), provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+    # -- producer thread -----------------------------------------------
+    def _start_producer(self):
+        import queue as _queue
+        import weakref
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._done = False
+        # the thread must NOT hold a strong ref to self: an abandoned
+        # iterator (consumer breaks out of the epoch early and drops it)
+        # could then never be garbage-collected and the producer would spin
+        # forever pinning queue_depth superbatches of device memory
+        wr = weakref.ref(self)
+
+        def produce(stop, q):
+            while not stop.is_set():
+                it = wr()
+                if it is None:
+                    return
+                group = None
+                try:
+                    group = it._pull_group()
+                    item = it._assemble(group) if group else None
+                except Exception as exc:  # surface in the consumer, don't
+                    item = exc            # leave it blocked on an empty queue
+                it = group = None  # drop the strong ref before blocking below
+                while not stop.is_set() and wr() is not None:
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if item is None or isinstance(item, Exception):
+                    return
+
+        self._thread = threading.Thread(target=produce,
+                                        args=(self._stop, self._queue))
+        self._thread.daemon = True
+        self._thread.start()
+
+    def _shutdown_producer(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        while self._thread.is_alive():
+            try:  # unblock a producer stuck on a full queue
+                self._queue.get_nowait()
+            except Exception:
+                pass
+            self._thread.join(timeout=0.05)
+        self._thread = None
+
+    def __del__(self):
+        try:
+            self._shutdown_producer()
+        except Exception:
+            pass
+
+    # -- DataIter interface --------------------------------------------
+    def reset(self):
+        if self._prefetch:
+            self._shutdown_producer()
+        self.base.reset()
+        self._done = False
+        if self._prefetch:
+            self._start_producer()
+
+    def close(self):
+        """Stop the producer thread and release the in-flight superbatches
+        WITHOUT resetting the base iterator. Call when done consuming (e.g.
+        fit() after its final epoch) — otherwise the producer keeps the base
+        iterator advanced by up to queue_depth prefetched superbatches and
+        their device buffers alive."""
+        if self._prefetch:
+            self._shutdown_producer()
+        self._queue = None
+        self._done = True
+
+    def next(self):
+        if self._done:
+            raise StopIteration
+        if self._prefetch:
+            item = self._queue.get()
+        else:
+            group = self._pull_group()
+            item = self._assemble(group) if group else None
+        if item is None:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        return item
 
 
 def _init_data(data, allow_empty, default_name):

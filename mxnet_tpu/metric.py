@@ -324,6 +324,43 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
     return CustomMetric(feval, name, allow_extra_outputs)
 
 
+# -- K-step dispatch aggregation (TrainStep.run_steps) ----------------------
+
+def supports_device_sums(metric):
+    """True when ``metric`` can consume the device-side K-step accumulators
+    (loss sum / top-1 correct / sample count) that ``TrainStep.run_steps``
+    carries through its scan — i.e. when ``Module.fit(steps_per_dispatch=k)``
+    can keep metrics on device and read back once per dispatch."""
+    if isinstance(metric, CompositeEvalMetric):
+        return bool(metric.metrics) and all(supports_device_sums(m)
+                                            for m in metric.metrics)
+    # exact types: subclasses may redefine what update() accumulates
+    if type(metric) is CrossEntropy:
+        return metric.eps == 1e-8  # the in-scan loss uses the default eps
+    return type(metric) is Accuracy and metric.axis == 1
+
+
+def update_from_device_sums(metric, sums):
+    """Fold one dispatch's accumulated sums (a ``train_step.StepMetrics``)
+    into ``metric`` — the K-step analog of ``metric.update(labels, preds)``
+    without the per-step host readbacks it would have cost."""
+    if isinstance(metric, CompositeEvalMetric):
+        for m in metric.metrics:
+            update_from_device_sums(m, sums)
+        return
+    if type(metric) is Accuracy:
+        metric.sum_metric += sums.top1_correct
+        metric.num_inst += sums.num_samples
+    elif type(metric) is CrossEntropy:
+        metric.sum_metric += sums.loss_sum
+        metric.num_inst += sums.num_samples
+    else:
+        raise MXNetError(
+            "%s cannot consume dispatch-level sums; train with "
+            "steps_per_dispatch=1 or use acc/ce metrics"
+            % type(metric).__name__)
+
+
 def create(metric, **kwargs):
     """Create metric by name or callable or list (ref: metric.py create)."""
     if callable(metric):

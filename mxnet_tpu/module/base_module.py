@@ -121,10 +121,22 @@ class BaseModule(object):
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """The training loop (ref: base_module.py:368-519)."""
+            monitor=None, steps_per_dispatch=None):
+        """The training loop (ref: base_module.py:368-519).
+
+        ``steps_per_dispatch=k`` (default: ``engine.bulk_size()``, normally
+        1) bulks K train steps into ONE compiled dispatch over a stacked
+        superbatch: Python dispatch overhead and the per-step host metric
+        readback amortize over K (docs/perf.md "Dispatch bulking"). Metric,
+        callback and lr-scheduler plumbing run at K-step granularity —
+        ``nbatch`` still counts single batches, but batch_end_callback fires
+        once per dispatch. Requires the fused fast path and an acc/ce-style
+        metric; configurations that cannot bulk fall back to k=1 with a
+        warning.
+        """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
+        from .. import engine as _engine
         if initializer is None:
             initializer = Uniform(0.01)
         self.bind(data_shapes=train_data.provide_data,
@@ -143,49 +155,111 @@ class BaseModule(object):
             eval_metric = _metric.create(eval_metric)
 
         fused_step = getattr(self, "_try_fused_fit_step", None)
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                # fast path: fwd+bwd+update fused into one donated jit
-                # (falls back to the general executor path when the module
-                # configuration needs it — monitor, dist kvstore, grad_req,
-                # unfused optimizer, bucketing/shared modules)
-                if monitor is not None or fused_step is None \
-                        or not fused_step(data_batch):
-                    self.forward_backward(data_batch)
-                    self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
+        fused_steps = getattr(self, "_try_fused_fit_steps", None)
+        k = (steps_per_dispatch if steps_per_dispatch is not None
+             else _engine.bulk_size())
+        k = max(1, int(k))
+        if k > 1:
+            reason = None
+            if monitor is not None:
+                reason = "a monitor needs per-step executor access"
+            elif fused_steps is None:
+                reason = "this module has no fused multi-step path"
+            elif not _metric.supports_device_sums(eval_metric):
+                reason = ("metric %r cannot consume device-side K-step sums"
+                          % eval_metric.name)
+            elif not hasattr(train_data, "superbatch"):
+                reason = "train_data is not a DataIter (no superbatch mode)"
+            else:
+                # module-level eligibility (optimizer/grad_req/dist/head
+                # shape) is knowable NOW — checking here instead of per
+                # dispatch avoids silently paying superbatch stacking for an
+                # epoch the per-step path ends up training anyway
+                can = getattr(self, "_can_bulk_dispatch", None)
+                if can is not None:
+                    ok, why = can()
+                    if not ok:
+                        reason = why
+            if reason is not None:
+                self.logger.warning(
+                    "steps_per_dispatch=%d unavailable (%s); training "
+                    "with 1", k, reason)
+                k = 1
+        train_iter = train_data.superbatch(k) if k > 1 else train_data
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = -1
+                for data_batch in train_iter:
+                    if monitor is not None:
+                        monitor.tic()
+                    # fast path: K fused steps in one donated lax.scan
+                    # dispatch, metrics accumulated on device, read back once
+                    if (k > 1 and getattr(data_batch, "num_steps", 0) == k
+                            and fused_steps(data_batch, eval_metric)):
+                        nbatch += data_batch.num_steps
+                    else:
+                        # per-step path: the general executor loop, also the
+                        # epoch tail (num_steps < k) without a K'-recompile
+                        for batch in (data_batch.unstack()
+                                      if hasattr(data_batch, "unstack")
+                                      else [data_batch]):
+                            nbatch += 1
+                            # fused single step (falls back to the executor
+                            # path when the module configuration needs it —
+                            # monitor, dist kvstore, grad_req, unfused
+                            # optimizer, bucketing/shared modules)
+                            if monitor is not None or fused_step is None \
+                                    or not fused_step(batch):
+                                self.forward_backward(batch)
+                                self.update()
+                            self.update_metric(eval_metric, batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch,
+                            eval_metric=eval_metric, locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
 
-            arg_params, aux_params = self.get_params()
-            self.set_params(arg_params, aux_params)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 (toc - tic))
 
-            if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-            train_data.reset()
+                arg_params, aux_params = self.get_params()
+                self.set_params(arg_params, aux_params)
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params, aux_params)
+
+                if eval_data:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                         name, val)
+                if train_iter is train_data or epoch < num_epoch - 1:
+                    train_iter.reset()
+                else:
+                    # final epoch of a superbatch wrapper: stop its producer
+                    # thread (reset() would spawn one that pre-pulls batches
+                    # from — and pins device buffers for — an epoch nobody
+                    # consumes) and hand the user back a reset base iterator
+                    train_iter.close()
+                    train_data.reset()
+        finally:
+            if train_iter is not train_data:
+                # exception paths included: never leave a producer thread
+                # consuming the user's iterator (close() is idempotent)
+                train_iter.close()
 
     # -- symbol / params accessors -------------------------------------
     @property

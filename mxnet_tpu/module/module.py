@@ -77,6 +77,7 @@ class Module(BaseModule):
         self._fused_ok = True
         self._fused_dirty = False
         self._fused_params_stale = False
+        self._fused_metrics_ok = False
         self._monitor_installed = False
 
     # -- checkpointing (ref: module.py:97-156, :674-704) ----------------
@@ -377,14 +378,17 @@ class Module(BaseModule):
                 return False
         return True
 
+    def _is_dist_kvstore(self):
+        return (self._kvstore is not None and "dist" in self._kvstore.type
+                and getattr(self._kvstore, "num_workers", 1) > 1)
+
     def _build_fused(self):
         from ..train_step import TrainStep
         eg = self._exec_group
         frozen = [n for n in eg.param_names
                   if eg.grad_req.get(n, "null") == "null"]
         mesh = eg._mesh
-        if (self._kvstore is not None and "dist" in self._kvstore.type
-                and self._kvstore.num_workers > 1):
+        if self._is_dist_kvstore():
             # dist_sync INSIDE the fused step: the batch shards over a
             # global mesh spanning every worker process and XLA places the
             # gradient psum over DCN/ICI exactly where the reference ran
@@ -398,6 +402,49 @@ class Module(BaseModule):
             mesh=mesh, frozen_param_names=frozen)
         self._fused_state = self._seed_fused_state()
         self._fused_params_stale = False
+        self._fused_metrics_ok = self._infer_fused_metrics_ok()
+
+    def _infer_fused_metrics_ok(self):
+        """The K-step scan's device metric sums are only well-defined for a
+        single (rank-2 output, rank-1 label) classification head — the
+        in-scan accumulator would double-count multi-head nets and report
+        zeros for non-matching shapes, where per-step host metrics see the
+        real outputs (run_steps pairs outputs/labels positionally)."""
+        try:
+            shapes = {}
+            for d in (self._data_shapes or []):
+                name, shape = ((d.name, d.shape) if hasattr(d, "name")
+                               else (d[0], d[1]))
+                shapes[name] = shape
+            lshapes = []
+            for l in (self._label_shapes or []):
+                name, shape = ((l.name, l.shape) if hasattr(l, "name")
+                               else (l[0], l[1]))
+                shapes[name] = shape
+                lshapes.append(shape)
+            _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+            return (len(out_shapes) == 1 and len(lshapes) == 1
+                    and len(out_shapes[0]) == 2 and len(lshapes[0]) == 1
+                    and out_shapes[0][0] == lshapes[0][0])
+        except Exception:
+            return False
+
+    def _can_bulk_dispatch(self):
+        """fit()'s precheck half of :meth:`_try_fused_fit_steps`: called
+        after init_optimizer so steps_per_dispatch>1 warns and skips the
+        superbatch wrapper up front instead of silently paying K-batch
+        stacking for dispatches the per-step path ends up training."""
+        if not self._fused_eligible():
+            return (False, "module configuration needs the per-step "
+                    "executor path (monitor/grad_req/unfused optimizer/"
+                    "shared module)")
+        if self._is_dist_kvstore():
+            return (False, "dist kvstore keeps per-step dispatch "
+                    "(per-step push/pull sync is the contract)")
+        if not self._infer_fused_metrics_ok():
+            return (False, "device metric sums need a single (rank-2 "
+                    "output, rank-1 label) head")
+        return True, None
 
     def _jnp_copy(self, x):
         import jax.numpy as jnp
@@ -491,6 +538,46 @@ class Module(BaseModule):
         # per-worker view of batch-sharded outputs (each worker's metric
         # covers its own shard, matching reference per-worker eval)
         self._fused_outputs = [NDArray(local_view(o)) for o in outs]
+        self._fused_dirty = True
+        self._params_dirty = True
+        return True
+
+    def _try_fused_fit_steps(self, super_batch, eval_metric):
+        """fit()'s K-step fast path: one donated ``lax.scan`` dispatch over a
+        stacked superbatch (``TrainStep.run_steps``), with loss/top-1/count
+        accumulated on device and folded into ``eval_metric`` via ONE host
+        readback. Returns False when the configuration needs the general
+        per-step path (which ``fit`` then takes for this superbatch)."""
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            return False
+        if self._fused is None:
+            if not self._fused_eligible():
+                return False
+            self._build_fused()
+        from ..parallel.mesh import is_multiprocess
+        if is_multiprocess(self._fused.mesh):
+            # dist workers keep per-step dispatch: the per-step kvstore sync
+            # semantics (and per-worker metric shards) are the contract
+            return False
+        if not getattr(self, "_fused_metrics_ok", False):
+            return False  # multi-head / non-classification: per-step metrics
+        if self._fused_params_stale:
+            self._fused_state = self._seed_fused_state(prev=self._fused_state)
+            self._fused_params_stale = False
+        eg = self._exec_group
+        batch = {}
+        for name, value in zip(eg.data_names, super_batch.data):
+            batch[name] = value
+        if eg.label_names and super_batch.label:
+            for name, value in zip(eg.label_names, super_batch.label):
+                batch[name] = value
+        batch = self._fused.shard_superbatch(batch)
+        self._fused_state, sums = self._fused.run_steps(self._fused_state,
+                                                        batch)
+        from .. import metric as _metric
+        _metric.update_from_device_sums(eval_metric, sums)
+        self._fused_outputs = None  # outputs stay on device, un-materialized
         self._fused_dirty = True
         self._params_dirty = True
         return True

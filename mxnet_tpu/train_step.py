@@ -35,6 +35,55 @@ P = jax.sharding.PartitionSpec
 _OPT_KEY_OFFSET = 1 << 20
 
 
+class StepMetrics(object):
+    """Device-resident metric accumulators for one K-step dispatch.
+
+    Holds the packed ``[loss_sum, top1_correct, num_samples]`` array produced
+    on device by ``TrainStep.run_steps``; the first property access performs
+    the ONE host readback for the whole dispatch (and doubles as the sync
+    point per-step training got from reading outputs every batch).
+    """
+
+    __slots__ = ("device", "_host")
+
+    def __init__(self, device_array):
+        self.device = device_array
+        self._host = None
+
+    def _vals(self):
+        if self._host is None:
+            self._host = np.asarray(self.device)
+        return self._host
+
+    @property
+    def loss_sum(self):
+        """Summed cross-entropy over every sample in the dispatch."""
+        return float(self._vals()[0])
+
+    @property
+    def top1_correct(self):
+        """Count of top-1 correct predictions in the dispatch."""
+        return float(self._vals()[1])
+
+    @property
+    def num_samples(self):
+        return int(self._vals()[2])
+
+    @property
+    def accuracy(self):
+        n = self.num_samples
+        return self.top1_correct / n if n else float("nan")
+
+    @property
+    def loss_avg(self):
+        n = self.num_samples
+        return self.loss_sum / n if n else float("nan")
+
+    def __repr__(self):
+        return ("StepMetrics(loss_sum=%.6g, top1_correct=%g, num_samples=%d)"
+                % (self.loss_sum, self.top1_correct, self.num_samples))
+
+
 class TrainStep(object):
     """Compiled train step over a symbol.
 
@@ -123,6 +172,7 @@ class TrainStep(object):
         if remat:
             self._run = self._wrap_remat(self._run)
         self._jit = {}  # keyed by batch size (rescale_grad depends on it)
+        self._jit_scan = {}  # keyed by (batch_size, k) — see run_steps
         self._base_key = None  # drawn lazily from the global seeded stream
 
     # ------------------------------------------------------------------
@@ -268,7 +318,10 @@ class TrainStep(object):
             for k, v in batch.items()}
 
     # ------------------------------------------------------------------
-    def _build(self, batch_size):
+    def _make_step_fn(self, batch_size):
+        """The fused fwd+bwd+update body, shared verbatim by the single-step
+        jit (``step``) and the K-step ``lax.scan`` dispatch (``run_steps``)
+        so both paths compute identical numbers."""
         run = self._run
         optzr = self._opt
         param_names = list(self.param_names)
@@ -328,29 +381,139 @@ class TrainStep(object):
                          "opt": new_opt, "step": state["step"] + 1}
             return new_state, outs
 
-        return jax.jit(step_fn, donate_argnums=(0,))
+        return step_fn
 
-    def step(self, state, batch):
-        """One fused train step. ``batch``: dict name -> array."""
-        bs = next(iter(batch.values())).shape[0]
-        if bs not in self._jit:
-            self._jit[bs] = self._build(bs)
+    def _build(self, batch_size):
+        return jax.jit(self._make_step_fn(batch_size), donate_argnums=(0,))
+
+    def _build_scan(self, batch_size, k):
+        """K steps in ONE compiled dispatch: lax.scan of the fused step body
+        over a stacked (k, batch, ...) superbatch, state donated across the
+        whole scan. This is the reference engine's bulking — whole graph
+        segments per engine dispatch (SURVEY.md §3.1) — applied to the train
+        loop itself: Python dispatch and host readback amortize over K steps.
+
+        Metric accumulators (CE loss sum, top-1 correct count, sample count)
+        are carried through the scan so metrics cross the host boundary once
+        per K steps. Accumulation pairs each rank-2 output with its label by
+        position, matching metric.CrossEntropy (eps 1e-8) / metric.Accuracy
+        (argmax axis=1) bit-for-bit over the same outputs.
+        """
+        step_fn = self._make_step_fn(batch_size)
+        label_names = list(self.label_names)
+
+        def scan_fn(state, superbatch, key, lrs):
+            zero = jnp.zeros((), jnp.float32)
+
+            def body(carry, xs):
+                st, (loss, correct, nsamp) = carry
+                batch, lr = xs
+                new_st, outs = step_fn(st, batch, key, lr)
+                for o, lname in zip(outs, label_names):
+                    lbl = batch.get(lname)
+                    if (lbl is not None and getattr(o, "ndim", 0) == 2
+                            and lbl.ndim == 1
+                            and o.shape[0] == lbl.shape[0]):
+                        li = lbl.astype(jnp.int32)
+                        p = o[jnp.arange(o.shape[0]), li].astype(jnp.float32)
+                        loss = loss + jnp.sum(-jnp.log(p + 1e-8))
+                        correct = correct + jnp.sum(
+                            (jnp.argmax(o, axis=1).astype(jnp.int32) == li)
+                            .astype(jnp.float32))
+                nsamp = nsamp + jnp.float32(batch_size)
+                return (new_st, (loss, correct, nsamp)), None
+
+            (state, (loss, correct, nsamp)), _ = jax.lax.scan(
+                body, (state, (zero, zero, zero)), (superbatch, lrs))
+            # one packed array => one host transfer for all K-step metrics
+            return state, jnp.stack([loss, correct, nsamp])
+
+        return jax.jit(scan_fn, donate_argnums=(0,))
+
+    def _dispatch_key(self):
         if self._needs_rng or getattr(self._opt, "fused_needs_key", False):
             # base key rides the global seeded stream (mx.random.seed), so
             # dropout/SGLD respond to seeding and two TrainSteps never share
             # noise; per-step keys fold in the step counter
             if self._base_key is None:
                 self._base_key = _random.split()
-            key = self._base_key  # per-step variation folds in state["step"]
-        else:
-            key = jax.random.key(0)  # static; unused ops ignore it
+            return self._base_key  # per-step variation folds in state["step"]
+        return jax.random.key(0)  # static; unused ops ignore it
+
+    def _next_lr(self):
         # scheduler clock advances host-side; lr rides in as a traced scalar
         self._opt.num_update += 1
         if self._opt.lr_scheduler is not None:
-            lr = self._opt.lr_scheduler(self._opt.num_update)
-        else:
-            lr = self._opt.lr
-        return self._jit[bs](state, batch, key, jnp.asarray(lr, jnp.float32))
+            return self._opt.lr_scheduler(self._opt.num_update)
+        return self._opt.lr
+
+    def step(self, state, batch):
+        """One fused train step. ``batch``: dict name -> array."""
+        bs = next(iter(batch.values())).shape[0]
+        if bs not in self._jit:
+            self._jit[bs] = self._build(bs)
+        return self._jit[bs](state, batch, self._dispatch_key(),
+                             jnp.asarray(self._next_lr(), jnp.float32))
+
+    def run_steps(self, state, superbatch, k=None):
+        """Run K fused train steps in ONE compiled dispatch.
+
+        ``superbatch``: dict name -> stacked array of shape (k, batch, ...)
+        (build one with ``io.SuperBatchIter`` / ``DataIter.superbatch(k)``,
+        or stack K batches yourself). The scheduler clock advances K host
+        updates and the per-step lr schedule rides in as a traced (k,)
+        vector, so schedules never retrace; the jit cache is keyed on
+        (batch_size, k), so a fixed K never recompiles across epochs.
+
+        Returns ``(new_state, metrics)`` where ``metrics`` is a
+        :class:`StepMetrics` holding the device-resident K-step accumulators
+        (loss sum, top-1 correct count, sample count) — reading any of its
+        properties performs the single host readback for the dispatch.
+        """
+        vals = list(superbatch.values())
+        if not vals:
+            raise MXNetError("run_steps: empty superbatch")
+        lead = vals[0].shape[0]
+        if k is not None and k != lead:
+            raise MXNetError("run_steps: k=%d but superbatch is stacked %d "
+                             "deep" % (k, lead))
+        k = lead
+        if any(v.shape[0] != k or v.ndim < 2 for v in vals):
+            raise MXNetError("run_steps: superbatch arrays must share a "
+                             "(k, batch, ...) leading shape, got %r"
+                             % {n: tuple(v.shape)
+                                for n, v in superbatch.items()})
+        bs = vals[0].shape[1]
+        if (bs, k) not in self._jit_scan:
+            self._jit_scan[(bs, k)] = self._build_scan(bs, k)
+        lrs = jnp.asarray([self._next_lr() for _ in range(k)], jnp.float32)
+        new_state, packed = self._jit_scan[(bs, k)](
+            state, superbatch, self._dispatch_key(), lrs)
+        return new_state, StepMetrics(packed)
+
+    def shard_superbatch(self, superbatch):
+        """Place stacked (k, batch, ...) arrays for the scan dispatch: dim 0
+        is the step axis (never sharded), dim 1 is the batch axis sharded
+        along 'data' — the superbatch analog of :meth:`shard_batch`."""
+        def to_jnp(v):
+            return v.data if isinstance(v, NDArray) else jnp.asarray(v)
+        if self.mesh is None:
+            return {n: to_jnp(v) for n, v in superbatch.items()}
+        from .parallel.mesh import is_multiprocess, AXIS_SEQ
+        if is_multiprocess(self.mesh):
+            raise MXNetError("shard_superbatch: multi-process meshes keep "
+                             "per-step dispatch (use step())")
+        has_seq = AXIS_SEQ in self.mesh.axis_names
+        bax = "data" if "data" in self.mesh.axis_names else None
+
+        def spec_for(v):
+            if has_seq and v.ndim >= 3:
+                return P(None, bax, AXIS_SEQ)
+            return P(None, bax)
+
+        return {n: jax.device_put(
+            to_jnp(v), jax.sharding.NamedSharding(self.mesh, spec_for(v)))
+            for n, v in superbatch.items()}
 
 
 def data_parallel_spec(mesh_shape, n_devices=None, devices=None):

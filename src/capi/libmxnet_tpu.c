@@ -205,12 +205,21 @@ MXTPU_EXPORT int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data,
                             Py_BuildValue("(K)", h));
     int rc = -1;
     if (v) {
-        Py_ssize_t n = PyBytes_Size(v);
+        size_t n = (size_t)PyBytes_Size(v);
         size_t want = size * sizeof(float);
-        if ((size_t)n < want) want = (size_t)n;
-        memcpy(data, PyBytes_AsString(v), want);
+        if (n != want) {
+            /* reference contract (CHECK_EQ(size, arr.Size())): a size
+             * mismatch is an error, never a silent truncation */
+            char msg[128];
+            snprintf(msg, sizeof(msg),
+                     "MXNDArraySyncCopyToCPU: caller size %zu bytes does "
+                     "not match array size %zu bytes", want, n);
+            set_err(msg);
+        } else {
+            memcpy(data, PyBytes_AsString(v), want);
+            rc = 0;
+        }
         Py_DECREF(v);
-        rc = 0;
     }
     PyGILState_Release(st);
     return rc;
@@ -224,16 +233,25 @@ MXTPU_EXPORT int MXNDArrayGetShape(NDArrayHandle h, uint32_t *out_dim,
     int rc = -1;
     if (v) {
         uint32_t n = (uint32_t)PySequence_Size(v);
-        uint32_t *buf = (uint32_t *)g_shape_buf;
-        for (uint32_t i = 0; i < n && i < 32; i++) {
-            PyObject *it = PySequence_GetItem(v, i);
-            buf[i] = (uint32_t)PyLong_AsUnsignedLong(it);
-            Py_DECREF(it);
+        if (n > 32) {
+            /* never hand out a buffer holding fewer dims than ndim claims */
+            char msg[96];
+            snprintf(msg, sizeof(msg),
+                     "MXNDArrayGetShape: ndim %u exceeds the 32-dim "
+                     "shape buffer", n);
+            set_err(msg);
+        } else {
+            uint32_t *buf = (uint32_t *)g_shape_buf;
+            for (uint32_t i = 0; i < n; i++) {
+                PyObject *it = PySequence_GetItem(v, i);
+                buf[i] = (uint32_t)PyLong_AsUnsignedLong(it);
+                Py_DECREF(it);
+            }
+            *out_dim = n;
+            *out_pdata = buf;
+            rc = 0;
         }
-        *out_dim = n;
-        *out_pdata = buf;
         Py_DECREF(v);
-        rc = 0;
     }
     PyGILState_Release(st);
     return rc;
@@ -640,16 +658,24 @@ MXTPU_EXPORT int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
     int rc = -1;
     if (v) {
         uint32_t n = (uint32_t)PySequence_Size(v);
-        uint32_t *buf = (uint32_t *)g_shape_buf;
-        for (uint32_t i = 0; i < n && i < 32; i++) {
-            PyObject *it = PySequence_GetItem(v, i);
-            buf[i] = (uint32_t)PyLong_AsUnsignedLong(it);
-            Py_DECREF(it);
+        if (n > 32) {
+            char msg[96];
+            snprintf(msg, sizeof(msg),
+                     "MXPredGetOutputShape: ndim %u exceeds the 32-dim "
+                     "shape buffer", n);
+            set_err(msg);
+        } else {
+            uint32_t *buf = (uint32_t *)g_shape_buf;
+            for (uint32_t i = 0; i < n; i++) {
+                PyObject *it = PySequence_GetItem(v, i);
+                buf[i] = (uint32_t)PyLong_AsUnsignedLong(it);
+                Py_DECREF(it);
+            }
+            *shape_data = buf;
+            *shape_ndim = n;
+            rc = 0;
         }
-        *shape_data = buf;
-        *shape_ndim = n;
         Py_DECREF(v);
-        rc = 0;
     }
     PyGILState_Release(st);
     return rc;
@@ -665,10 +691,17 @@ MXTPU_EXPORT int MXPredGetOutput(PredictorHandle h, uint32_t index,
     if (v) {
         size_t n = (size_t)PyBytes_Size(v);
         size_t want = (size_t)size * 4;
-        if (n < want) want = n;
-        memcpy(data, PyBytes_AsString(v), want);
+        if (n != want) {
+            char msg[128];
+            snprintf(msg, sizeof(msg),
+                     "MXPredGetOutput: caller size %zu bytes does not "
+                     "match output size %zu bytes", want, n);
+            set_err(msg);
+        } else {
+            memcpy(data, PyBytes_AsString(v), want);
+            rc = 0;
+        }
         Py_DECREF(v);
-        rc = 0;
     }
     PyGILState_Release(st);
     return rc;
@@ -2017,17 +2050,32 @@ MXTPU_EXPORT int MXKVStoreRunServer(KVStoreHandle h,
     return call_void("MXKVStoreRunServer", Py_BuildValue("(K)", h));
 }
 
-MXTPU_EXPORT int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_id,
-                                                const char *cmd_body) {
+/* Length-explicit variant: command bodies are arbitrary bytes (the cmd_id 0
+ * kController body is a pickled optimizer, which contains NULs), so the
+ * NUL-terminated legacy signature cannot carry them faithfully. */
+MXTPU_EXPORT int MXKVStoreSendCommmandToServersEx(KVStoreHandle h, int cmd_id,
+                                                  const char *cmd_body,
+                                                  size_t body_len) {
     ENSURE();
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *pb = PyBytes_FromString(cmd_body ? cmd_body : "");
+    PyObject *pb = PyBytes_FromStringAndSize(cmd_body ? cmd_body : "",
+                                             cmd_body ? (Py_ssize_t)body_len
+                                                      : 0);
     PyObject *v = capi_call("MXKVStoreSendCommmandToServers",
                             Py_BuildValue("(KiN)", h, cmd_id, pb));
     int rc = v ? 0 : -1;
     Py_XDECREF(v);
     PyGILState_Release(st);
     return rc;
+}
+
+MXTPU_EXPORT int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_id,
+                                                const char *cmd_body) {
+    /* legacy NUL-terminated entry point: delegate with an explicit length
+     * so the marshalled body is exactly what strlen sees (binary bodies
+     * must use the Ex variant) */
+    return MXKVStoreSendCommmandToServersEx(
+        h, cmd_id, cmd_body, cmd_body ? strlen(cmd_body) : 0);
 }
 
 MXTPU_EXPORT int MXKVStoreSetBarrierBeforeExit(KVStoreHandle h,

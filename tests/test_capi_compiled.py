@@ -268,3 +268,61 @@ def test_mxpred_python_surface():
     ref /= ref.sum(1, keepdims=True)
     np.testing.assert_allclose(out, ref, atol=1e-5)
     assert c_api.MXPredFree(h)[0] == 0
+
+
+def test_compiled_abi_error_contracts_r6():
+    """r6 hardening: shape queries reject ndim > the 32-dim return buffer,
+    CPU copies reject size mismatches instead of silently truncating, and
+    kvstore command bodies marshal length-explicit (binary pickles carry
+    NULs that the legacy NUL-terminated entry point cannot)."""
+    import ctypes
+    import pickle
+    if not os.path.exists(LIB):
+        pytest.skip("lib not built")
+    import numpy as np
+    from mxnet_tpu import c_api, optimizer as opt
+
+    lib = ctypes.CDLL(LIB)  # shares the live interpreter's handle registry
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    # -- copy size mismatch -> -1, exact size -> 0 ----------------------
+    _, h = c_api.MXNDArrayCreateFromNumpy(
+        np.arange(6, dtype=np.float32).reshape(2, 3))
+    buf = (ctypes.c_float * 6)()
+    assert lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_uint64(h), buf, ctypes.c_size_t(6)) == 0
+    assert [buf[i] for i in range(6)] == [0, 1, 2, 3, 4, 5]
+    assert lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_uint64(h), buf, ctypes.c_size_t(4)) == -1
+    assert b"does not match" in lib.MXGetLastError()
+    assert lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_uint64(h), buf, ctypes.c_size_t(8)) == -1
+
+    # -- shape ndim > 32 -> -1 with message, never a truncated buffer ---
+    try:
+        _, h33 = c_api.MXNDArrayCreateFromNumpy(
+            np.zeros((1,) * 33, np.float32))
+        ok = _ == 0
+    except Exception:
+        ok = False
+    if ok:
+        ndim = ctypes.c_uint32()
+        pdata = ctypes.POINTER(ctypes.c_uint32)()
+        assert lib.MXNDArrayGetShape(ctypes.c_uint64(h33),
+                                     ctypes.byref(ndim),
+                                     ctypes.byref(pdata)) == -1
+        assert b"32-dim" in lib.MXGetLastError()
+
+    # -- kvstore command body: length-explicit Ex carries binary pickles
+    _, kv = c_api.MXKVStoreCreate("local")
+    body = pickle.dumps(opt.create("sgd", learning_rate=0.25))
+    assert b"\x00" in body  # the truncation hazard is real
+    assert lib.MXKVStoreSendCommmandToServersEx(
+        ctypes.c_uint64(kv), 0, ctypes.c_char_p(body),
+        ctypes.c_size_t(len(body))) == 0
+    assert abs(c_api._get(kv)._updater.optimizer.lr - 0.25) < 1e-9
+    # the legacy NUL-terminated path truncates the pickle -> the python
+    # side must now REJECT the garbage body instead of swallowing it
+    assert lib.MXKVStoreSendCommmandToServers(
+        ctypes.c_uint64(kv), 0, ctypes.c_char_p(body)) == -1
+    assert b"unpickle" in lib.MXGetLastError()

@@ -195,6 +195,266 @@ def test_module_fused_checkpoint_roundtrip(tmp_path):
     assert float(jnp.abs(mom).max()) > 0.0
 
 
+# -- multi-step dispatch (run_steps / steps_per_dispatch) -------------------
+
+def _stacked_batches(k=4, batch=8, seed=11):
+    rng = np.random.default_rng(seed)
+    Xs = rng.normal(size=(k, batch, 10)).astype(np.float32)
+    ys = rng.integers(0, 4, (k, batch)).astype(np.float32)
+    return Xs, ys
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_run_steps_matches_sequential(momentum):
+    """run_steps(state, sb, k) == K sequential step() calls: params AND the
+    device metric sums against host Accuracy/CrossEntropy over the same
+    per-step outputs."""
+    net = _mlp()
+    K, B = 4, 8
+    Xs, ys = _stacked_batches(K, B)
+
+    def mk():
+        o = opt.create("sgd", learning_rate=0.05, momentum=momentum,
+                       rescale_grad=1.0 / B)
+        o.wd = 1e-3
+        return o
+
+    from mxnet_tpu import metric as _metric
+    stepA = TrainStep(net, optimizer=mk())
+    sA = stepA.init({"data": (B, 10)}, {"softmax_label": (B,)}, seed=1)
+    acc, ce = _metric.Accuracy(), _metric.CrossEntropy()
+    for i in range(K):
+        sA, outs = stepA.step(sA, {"data": jnp.asarray(Xs[i]),
+                                   "softmax_label": jnp.asarray(ys[i])})
+        acc.update([ys[i]], [np.asarray(outs[0])])
+        ce.update([ys[i]], [np.asarray(outs[0])])
+
+    stepB = TrainStep(net, optimizer=mk())
+    sB = stepB.init({"data": (B, 10)}, {"softmax_label": (B,)}, seed=1)
+    sB, sums = stepB.run_steps(sB, {"data": jnp.asarray(Xs),
+                                    "softmax_label": jnp.asarray(ys)}, k=K)
+
+    for n in stepA.param_names:
+        np.testing.assert_allclose(
+            np.asarray(sA["params"][n]), np.asarray(sB["params"][n]),
+            atol=1e-6, rtol=1e-6, err_msg=n)
+    assert int(np.asarray(sB["step"])) == K
+    assert sums.num_samples == K * B
+    assert sums.top1_correct == acc.sum_metric
+    np.testing.assert_allclose(sums.loss_sum, ce.sum_metric, rtol=1e-5)
+
+
+def test_run_steps_lr_scheduler_granularity():
+    """A scheduler stepping INSIDE the dispatch window must produce the same
+    trajectory as per-step dispatch: lrs ride in as a traced (k,) vector."""
+    net = _mlp()
+    K, B = 4, 8
+    Xs, ys = _stacked_batches(K, B, seed=5)
+
+    def mk():
+        return opt.create("sgd", learning_rate=0.2, momentum=0.9,
+                          rescale_grad=1.0 / B,
+                          lr_scheduler=mx.lr_scheduler.FactorScheduler(
+                              step=3, factor=0.5))
+
+    stepA = TrainStep(net, optimizer=mk())
+    sA = stepA.init({"data": (B, 10)}, {"softmax_label": (B,)}, seed=3)
+    for i in range(K):
+        sA, _ = stepA.step(sA, {"data": jnp.asarray(Xs[i]),
+                                "softmax_label": jnp.asarray(ys[i])})
+
+    stepB = TrainStep(net, optimizer=mk())
+    sB = stepB.init({"data": (B, 10)}, {"softmax_label": (B,)}, seed=3)
+    sB, _ = stepB.run_steps(sB, {"data": jnp.asarray(Xs),
+                                 "softmax_label": jnp.asarray(ys)})
+
+    for n in stepA.param_names:
+        np.testing.assert_allclose(
+            np.asarray(sA["params"][n]), np.asarray(sB["params"][n]),
+            atol=1e-6, rtol=1e-6, err_msg=n)
+
+
+def test_run_steps_no_retrace_across_epochs():
+    """Same (batch, k) shape must reuse ONE compiled scan across epochs;
+    different k compiles separately, returning to a seen k reuses it."""
+    net = _mlp()
+    B = 8
+    step = TrainStep(net, optimizer="sgd", learning_rate=0.05)
+    state = step.init({"data": (B, 10)}, {"softmax_label": (B,)}, seed=1)
+
+    for k in (2, 4, 2, 2, 4):  # "epochs" of varying K
+        Xs, ys = _stacked_batches(k, B, seed=k)
+        state, _ = step.run_steps(state, {"data": jnp.asarray(Xs),
+                                          "softmax_label": jnp.asarray(ys)})
+    assert set(step._jit_scan) == {(B, 2), (B, 4)}
+    for fn in step._jit_scan.values():
+        assert fn._cache_size() == 1, "scan retraced for an already-seen K"
+
+
+def test_run_steps_shape_validation():
+    net = _mlp()
+    step = TrainStep(net, optimizer="sgd")
+    state = step.init({"data": (8, 10)}, {"softmax_label": (8,)})
+    Xs, ys = _stacked_batches(4, 8)
+    with pytest.raises(mx.base.MXNetError):
+        step.run_steps(state, {"data": jnp.asarray(Xs),
+                               "softmax_label": jnp.asarray(ys)}, k=3)
+    with pytest.raises(mx.base.MXNetError):
+        step.run_steps(state, {"data": jnp.asarray(Xs),
+                               "softmax_label": jnp.asarray(ys[:2])})
+
+
+def test_module_fit_steps_per_dispatch_parity():
+    """Module.fit(steps_per_dispatch=k) == k=1: same final params and the
+    same train metric over the epoch (device sums vs per-step update)."""
+    final_metric = {}
+
+    def train(k):
+        net = _mlp()
+        it, X, y = _fit_data(shuffle=False)
+        mod = mx.mod.Module(net)
+        mx.random.seed(7)
+        captured = []
+        mod.fit(it, num_epoch=3, initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                eval_metric=mx.metric.create(["acc", "ce"]),
+                steps_per_dispatch=k,
+                batch_end_callback=lambda p: captured.append(
+                    [v for _, v in p.eval_metric.get_name_value()]))
+        final_metric[k] = captured[-1]
+        return mod.get_params()[0]
+
+    a = train(1)
+    b = train(4)
+    for n in a:
+        np.testing.assert_allclose(a[n].asnumpy(), b[n].asnumpy(),
+                                   atol=1e-5, rtol=1e-5, err_msg=n)
+    np.testing.assert_allclose(final_metric[1], final_metric[4], rtol=1e-5)
+
+
+def test_module_fit_steps_per_dispatch_epoch_tail():
+    """96 samples / batch 16 = 6 batches; k=4 leaves a 2-batch tail that
+    must train through the per-step path — every sample still seen, and the
+    metric must cover all of them."""
+    net = _mlp()
+    it, X, y = _fit_data(n=96, shuffle=False)
+    mod = mx.mod.Module(net)
+    seen = []
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1},
+            steps_per_dispatch=4,
+            batch_end_callback=lambda p: seen.append(
+                (p.nbatch, p.eval_metric.num_inst)))
+    assert int(np.asarray(mod._fused_state["step"])) == 6
+    assert seen[-1][0] == 5  # nbatch counts single batches
+    assert seen[-1][1] == 96  # metric covered every sample
+
+
+def test_module_fit_unsupported_metric_falls_back():
+    net = _mlp()
+    it, X, y = _fit_data(shuffle=False)
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric="mse", steps_per_dispatch=4)
+    # fell back to per-step dispatch but still trained
+    assert int(np.asarray(mod._fused_state["step"])) == 4
+
+
+def test_engine_bulk_scope_sets_fit_default():
+    net = _mlp()
+    it, X, y = _fit_data(shuffle=False)
+    mod = mx.mod.Module(net)
+    assert mx.engine.bulk_size() == 1
+    with mx.engine.bulk(4):
+        assert mx.engine.bulk_size() == 4
+        mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.1})
+    assert mx.engine.bulk_size() == 1
+    # the K-step scan path was engaged by the engine default
+    assert (16, 4) in mod._fused._jit_scan
+
+
+def test_module_fit_multihead_keeps_per_step_metrics():
+    """Two softmax heads: the in-scan accumulator would double-count, so
+    fit(steps_per_dispatch=k) must keep the per-step metric path — and the
+    reported accuracy must match the k=1 run exactly."""
+    def build():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        a = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(net, num_hidden=4, name="ha"), name="sa")
+        b = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(net, num_hidden=4, name="hb"), name="sb")
+        return mx.sym.Group([a, b])
+
+    def train(k):
+        it, X, y = _fit_data(shuffle=False)
+        mod = mx.mod.Module(build(), label_names=("sa_label", "sb_label"))
+        mx.random.seed(9)
+        acc = mx.metric.Accuracy()
+        # two labels: reuse y for both heads
+        class TwoLabelIter(mx.io.DataIter):
+            def __init__(self, base):
+                super().__init__(base.batch_size)
+                self.base = base
+            @property
+            def provide_data(self):
+                return self.base.provide_data
+            @property
+            def provide_label(self):
+                d = self.base.provide_label[0]
+                return [mx.io.DataDesc("sa_label", d.shape, d.dtype),
+                        mx.io.DataDesc("sb_label", d.shape, d.dtype)]
+            def reset(self):
+                self.base.reset()
+            def next(self):
+                b = self.base.next()
+                return mx.io.DataBatch(data=b.data, label=b.label * 2,
+                                       pad=b.pad)
+        mod.fit(TwoLabelIter(it), num_epoch=2,
+                initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=acc, steps_per_dispatch=k)
+        return mod, dict(acc.get_name_value())["accuracy"]
+
+    mod4, acc4 = train(4)
+    assert mod4._fused is not None and not mod4._fused_metrics_ok
+    assert mod4._fused._jit_scan == {}  # scan path never engaged
+    _, acc1 = train(1)
+    np.testing.assert_allclose(acc4, acc1, rtol=1e-6)
+
+
+def test_speedometer_fires_under_dispatch_jumps():
+    """batch_end arrives in K-batch jumps under steps_per_dispatch; the
+    Speedometer must still fire on every `frequent` boundary crossing."""
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.module.base_module import BatchEndParam
+    import logging as _logging
+    sp = Speedometer(batch_size=16, frequent=50)
+    fired = []
+    orig = _logging.info
+    _logging.info = lambda *a: fired.append(a)
+    try:
+        for nbatch in range(7, 500, 8):  # K=8 jumps: 7, 15, ..., never %50==0
+            sp(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                             locals=None))
+    finally:
+        _logging.info = orig
+    assert len(fired) == 9  # one per 50-batch boundary crossed
+
+
+def test_fit_superbatch_leaves_iterator_reset():
+    """After fit(steps_per_dispatch=k) returns, no producer thread may keep
+    consuming the user's iterator: a fresh epoch must see every batch."""
+    net = _mlp()
+    it, X, y = _fit_data(shuffle=False)
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1}, steps_per_dispatch=2)
+    assert len(list(it)) == 4  # all 64/16 batches still there
+
+
 def test_module_fixed_params_stay_fixed():
     net = _mlp()
     it, X, y = _fit_data()
@@ -208,3 +468,45 @@ def test_module_fixed_params_stay_fixed():
         assert mod._try_fused_fit_step(batch)
     np.testing.assert_array_equal(
         w0, np.asarray(mod._fused_state["params"]["fc1_weight"]))
+
+
+def test_fit_exception_stops_producer_thread():
+    """An exception escaping fit(steps_per_dispatch=k) must not leave a
+    producer thread consuming the user's iterator."""
+    import threading
+    import time as _t
+    net = _mlp()
+    it, X, y = _fit_data(shuffle=False)
+    mod = mx.mod.Module(net)
+    before = set(threading.enumerate())
+
+    def boom(_param):
+        raise ValueError("stop training")
+
+    with pytest.raises(ValueError, match="stop training"):
+        mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.1},
+                steps_per_dispatch=2, batch_end_callback=boom)
+    deadline = _t.time() + 3.0
+    while _t.time() < deadline and set(threading.enumerate()) - before:
+        _t.sleep(0.05)
+    assert not (set(threading.enumerate()) - before), "producer still alive"
+
+
+def test_log_train_metric_fires_under_dispatch_jumps():
+    from mxnet_tpu.callback import log_train_metric
+    from mxnet_tpu.module.base_module import BatchEndParam
+    import logging as _logging
+    cb = log_train_metric(50)
+    m = mx.metric.Accuracy()
+    m.sum_metric, m.num_inst = 5, 10
+    fired = []
+    orig = _logging.info
+    _logging.info = lambda *a: fired.append(a)
+    try:
+        for nbatch in range(7, 500, 8):  # K=8 jumps, never % 50 == 0
+            cb(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=m,
+                             locals=None))
+    finally:
+        _logging.info = orig
+    assert len(fired) == 10  # batch 7 (crosses -1->0) + 9 later boundaries

@@ -275,3 +275,25 @@ def test_det_iter_mirror_consistency(tmp_path):
         if b[1] > 0.4:  # flipped: object now on the right
             flipped += 1
     assert 0 < flipped < 8  # rand_mirror actually flips some
+
+
+def test_image_iter_superbatch_host_stacking(tmp_path):
+    """ImageIter.next_host feeds SuperBatchIter host-side: stacking happens
+    before any device transfer, and the superbatch matches per-batch next()."""
+    from mxnet_tpu.image import ImageIter
+    rec, jpegs = _make_rec(tmp_path, n=12, h=64, w=64)
+    mk = lambda: ImageIter(batch_size=4, data_shape=(3, 64, 64),
+                           path_imgrec=rec, shuffle=False)
+    hb = mk().next_host()
+    assert isinstance(hb.data[0], np.ndarray)  # host numpy, no device array
+
+    sbs = list(mk().superbatch(2, prefetch=False))
+    assert [sb.num_steps for sb in sbs] == [2, 1]
+    assert sbs[0].data[0].shape == (2, 4, 3, 64, 64)
+    ref = list(mk())
+    np.testing.assert_array_equal(
+        sbs[0].data[0].asnumpy(),
+        np.stack([ref[0].data[0].asnumpy(), ref[1].data[0].asnumpy()]))
+    np.testing.assert_array_equal(
+        sbs[0].label[0].asnumpy(),
+        np.stack([ref[0].label[0].asnumpy(), ref[1].label[0].asnumpy()]))

@@ -132,3 +132,146 @@ def test_recordio_python_roundtrip(tmp_path):
     h, payload = recordio.unpack(r.read_idx(3))
     assert h.label == 3.0
     assert payload == b"payload3"
+
+
+# -- superbatch mode (K-steps-per-dispatch input side) ----------------------
+
+def test_superbatch_iter_stacks_k_batches():
+    X = np.arange(96).reshape(24, 4).astype(np.float32)
+    y = np.arange(24).astype(np.float32)
+    it = io.NDArrayIter(X, y, batch_size=4).superbatch(3, prefetch=False)
+    assert it.provide_data[0].shape == (3, 4, 4)
+    assert it.provide_label[0].shape == (3, 4)
+    sbs = list(it)
+    assert len(sbs) == 2
+    assert sbs[0].num_steps == 3
+    assert sbs[0].data[0].shape == (3, 4, 4)
+    np.testing.assert_array_equal(sbs[0].data[0].asnumpy(),
+                                  X[:12].reshape(3, 4, 4))
+    np.testing.assert_array_equal(sbs[1].label[0].asnumpy(),
+                                  y[12:].reshape(3, 4))
+
+
+def test_superbatch_iter_partial_tail_and_discard():
+    X = np.arange(80).reshape(20, 4).astype(np.float32)
+    it = io.NDArrayIter(X, None, batch_size=4,
+                        last_batch_handle="discard")
+    sbs = list(it.superbatch(3, prefetch=False))
+    assert [sb.num_steps for sb in sbs] == [3, 2]  # 5 batches -> 3 + tail 2
+    per_step = [b.data[0].shape for sb in sbs for b in sb.unstack()]
+    assert per_step == [(4, 4)] * 5
+    it.reset()
+    sbs = list(it.superbatch(3, prefetch=False, last_group_handle="discard"))
+    assert [sb.num_steps for sb in sbs] == [3]
+
+
+def test_superbatch_iter_prefetch_thread_and_reset():
+    X = np.arange(192).reshape(48, 4).astype(np.float32)
+    y = np.arange(48).astype(np.float32)
+    it = io.NDArrayIter(X, y, batch_size=4).superbatch(4)  # threaded
+    for _ in range(2):  # two epochs through reset()
+        sbs = list(it)
+        assert len(sbs) == 3
+        np.testing.assert_array_equal(sbs[0].data[0].asnumpy(),
+                                      X[:16].reshape(4, 4, 4))
+        np.testing.assert_array_equal(sbs[2].label[0].asnumpy(),
+                                      y[32:].reshape(4, 4))
+        it.reset()
+
+
+def test_superbatch_unstack_preserves_pads():
+    X = np.arange(72).reshape(18, 4).astype(np.float32)
+    it = io.NDArrayIter(X, None, batch_size=4)  # last batch pad=2
+    sbs = list(it.superbatch(5, prefetch=False))
+    assert sbs[0].num_steps == 5
+    assert sbs[0].pads == [0, 0, 0, 0, 2]
+    assert [b.pad for b in sbs[0].unstack()] == [0, 0, 0, 0, 2]
+
+
+def test_superbatch_feeds_run_steps():
+    """End-to-end: SuperBatchIter output drives TrainStep.run_steps."""
+    import jax.numpy as jnp
+    from mxnet_tpu.train_step import TrainStep
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 10)).astype(np.float32)
+    y = rng.integers(0, 4, 32).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    step = TrainStep(net, optimizer="sgd", learning_rate=0.1)
+    state = step.init({"data": (8, 10)}, {"softmax_label": (8,)})
+    it = io.NDArrayIter(X, y, batch_size=8).superbatch(2, prefetch=False)
+    total = 0
+    for sb in it:
+        batch = {"data": sb.data[0].data, "softmax_label": sb.label[0].data}
+        state, sums = step.run_steps(state, batch)
+        total += sums.num_samples
+    assert total == 32
+    assert int(np.asarray(state["step"])) == 4
+
+
+def test_superbatch_producer_error_propagates():
+    class Boom(io.DataIter):
+        def __init__(self):
+            super().__init__(4)
+            self.n = 0
+        @property
+        def provide_data(self):
+            return [io.DataDesc("data", (4, 2))]
+        @property
+        def provide_label(self):
+            return []
+        def next(self):
+            self.n += 1
+            if self.n > 2:
+                raise RuntimeError("corrupt record")
+            return io.DataBatch(data=[np.zeros((4, 2), np.float32)],
+                                label=[], pad=0)
+
+    it = Boom().superbatch(2)  # threaded
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="corrupt record"):
+        for _ in it:
+            pass
+
+
+def test_superbatch_abandoned_iterator_is_collectable():
+    """The producer thread must not hold a strong ref to the iterator: an
+    abandoned SuperBatchIter must be GC-able and its thread must exit."""
+    import gc
+    X = np.zeros((64, 2), np.float32)
+    it = io.NDArrayIter(X, None, batch_size=4).superbatch(2)  # threaded
+    it.next()  # producer running, queue filling
+    th = it._thread
+    del it
+    gc.collect()
+    th.join(timeout=3.0)
+    assert not th.is_alive()
+
+
+def test_superbatch_accepts_legacy_tuple_descs():
+    class TupleIter(io.DataIter):
+        def __init__(self):
+            super().__init__(4)
+            self.n = 0
+        @property
+        def provide_data(self):
+            return [("data", (4, 2))]  # legacy descriptor form
+        @property
+        def provide_label(self):
+            return []
+        def reset(self):
+            self.n = 0
+        def next(self):
+            if self.n >= 4:
+                raise StopIteration
+            self.n += 1
+            return io.DataBatch(data=[np.full((4, 2), self.n, np.float32)],
+                                label=[], pad=0)
+
+    it = TupleIter().superbatch(2, prefetch=False)
+    assert it.provide_data[0].shape == (2, 4, 2)
+    sbs = list(it)
+    assert [sb.num_steps for sb in sbs] == [2, 2]
+    np.testing.assert_array_equal(sbs[0].data[0].asnumpy()[:, 0, 0], [1, 2])
