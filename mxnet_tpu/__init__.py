@@ -5,6 +5,7 @@ See SURVEY.md at the repo root for the structural map of the reference
 (lyttonhao/mxnet, v0.9.5) this framework reproduces, TPU-first.
 """
 from .base import MXNetError, __version__
+from . import faults
 from . import initialize as _initialize  # signal handlers (initialize.cc)
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_devices
 from . import base

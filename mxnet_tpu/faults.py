@@ -1,0 +1,209 @@
+"""Deterministic fault injection for the async training pipeline.
+
+The paper's dependency-engine design assumes every async stage — compute
+dispatch, H2D copy, IO/prefetch threads, KVStore push/pull — can fail
+independently. This registry makes each of those failure modes *repeatable*:
+a named call-site counts its invocations and an armed rule fires on exactly
+the nth call, so every recovery path (retry, skip, checkpoint fallback,
+degradation) is tested without sleeps, races or flaky timing.
+
+Sites instrumented across the codebase (new sites register implicitly on
+first :func:`fire`):
+
+===========================  ==============================================
+site                         where it fires
+===========================  ==============================================
+``io.record_read``           per record read in ``image.ImageIter``
+``io.batch_read``            per batch pull in ``io.SuperBatchIter``
+``io.h2d``                   per host->device superbatch slot transfer
+``superbatch.producer``      top of the SuperBatchIter producer loop
+``checkpoint.write``         before an atomic checkpoint file write
+``checkpoint.write.mid``     mid-stream, after half the payload is written
+``kvstore.push``             before a KVStore push
+``kvstore.pull``             before a KVStore pull
+``kvstore.barrier``          before a KVStore barrier
+``kvstore.dead_node``        inside ``KVStore.check_health``
+===========================  ==============================================
+
+Rule kinds:
+
+- ``"raise"``      raise :class:`InjectedFault` (not retried by retry helpers)
+- ``"transient"``  raise :class:`InjectedTransientFault` (retry-eligible)
+- ``"delay"``      ``time.sleep(delay)`` then continue (timeout testing)
+- any other string is returned from :func:`fire` for the site to interpret
+  (``"truncate"`` torn checkpoint write, ``"die"`` abrupt producer-thread
+  death, ``"drop"`` kvstore message loss, ``"dead:N"`` N dead workers)
+
+Arming is programmatic (``faults.inject(site, nth=3, kind="transient")``,
+or the :func:`scoped` context manager) or environment-driven for subprocess
+tests::
+
+    MXTPU_FAULTS="io.record_read@3=transient*2,checkpoint.write@1=truncate"
+
+meaning: calls 3 and 4 to ``io.record_read`` raise a transient fault, and
+the first checkpoint write is torn. Everything is guarded by one lock so
+producer threads and the consumer count against the same clock.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .base import MXNetError
+
+
+class InjectedFault(MXNetError):
+    """A failure fired by the fault-injection registry."""
+
+    def __init__(self, site, attempt, kind="raise"):
+        self.site = site
+        self.attempt = attempt
+        self.kind = kind
+        super().__init__("injected %s fault at %s (call #%d)"
+                         % (kind, site, attempt))
+
+
+class InjectedTransientFault(InjectedFault):
+    """A retry-eligible injected failure (the retry helpers in
+    :mod:`mxnet_tpu.io` and :mod:`mxnet_tpu.kvstore` treat this like a
+    transient IO/network error)."""
+
+    def __init__(self, site, attempt):
+        super().__init__(site, attempt, kind="transient")
+
+
+class _Rule(object):
+    __slots__ = ("site", "nth", "times", "kind", "exc", "delay")
+
+    def __init__(self, site, nth, times, kind, exc, delay):
+        self.site = site
+        self.nth = int(nth)
+        self.times = int(times)
+        self.kind = kind
+        self.exc = exc
+        self.delay = delay
+
+    def covers(self, call_no):
+        return self.nth <= call_no < self.nth + self.times
+
+
+_lock = threading.RLock()
+_rules = {}     # site -> [_Rule]
+_counts = {}    # site -> total fire() calls
+_env_loaded = False
+
+
+def _load_env_locked():
+    """Parse MXTPU_FAULTS once (lazily, under _lock)."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get("MXTPU_FAULTS", "").strip()
+    if not spec:
+        return
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            site_at, kind_times = part.split("=", 1)
+            site, nth = (site_at.split("@", 1) + ["1"])[:2] \
+                if "@" in site_at else (site_at, "1")
+            kind, times = (kind_times.split("*", 1) + ["1"])[:2] \
+                if "*" in kind_times else (kind_times, "1")
+            _rules.setdefault(site.strip(), []).append(
+                _Rule(site.strip(), int(nth), int(times), kind.strip(),
+                      None, 0.05))
+        except (ValueError, TypeError):
+            raise MXNetError(
+                "MXTPU_FAULTS: cannot parse %r (expected "
+                "'site@nth=kind*times', e.g. 'io.record_read@3=transient*2')"
+                % part)
+
+
+def inject(site, nth=1, kind="raise", times=1, exc=None, delay=0.05):
+    """Arm a fault: calls ``nth .. nth+times-1`` to ``fire(site)`` trigger
+    ``kind``. ``nth`` counts from 1 relative to the site's current call
+    count (an already-hot site fires ``nth`` calls from *now*)."""
+    with _lock:
+        _load_env_locked()
+        base = _counts.get(site, 0)
+        _rules.setdefault(site, []).append(
+            _Rule(site, base + nth, times, kind, exc, delay))
+
+
+def clear(site=None):
+    """Disarm rules (one site, or all) and reset call counts."""
+    with _lock:
+        global _env_loaded
+        _env_loaded = True  # an explicit clear() also discards env rules
+        if site is None:
+            _rules.clear()
+            _counts.clear()
+        else:
+            _rules.pop(site, None)
+            _counts.pop(site, None)
+
+
+def count(site):
+    """Total ``fire`` calls seen at a site (for assertions in tests)."""
+    with _lock:
+        return _counts.get(site, 0)
+
+
+def fire(site):
+    """Hook called at an instrumented site.
+
+    Returns ``None`` (no rule armed / not this call), or an action string
+    the site interprets; raises for ``raise``/``transient`` kinds; sleeps
+    for ``delay`` kind. Thread-safe; the sleep/raise happens outside the
+    lock.
+    """
+    with _lock:
+        _load_env_locked()
+        call_no = _counts.get(site, 0) + 1
+        _counts[site] = call_no
+        hit = None
+        for rule in _rules.get(site, ()):
+            if rule.covers(call_no):
+                hit = rule
+                break
+    if hit is None:
+        return None
+    if hit.kind == "raise":
+        if hit.exc is not None:
+            try:
+                raise hit.exc(site, call_no)
+            except TypeError:
+                raise hit.exc("injected fault at %s (call #%d)"
+                              % (site, call_no))
+        raise InjectedFault(site, call_no)
+    if hit.kind == "transient":
+        raise InjectedTransientFault(site, call_no)
+    if hit.kind == "delay":
+        time.sleep(hit.delay)
+        return "delay"
+    return hit.kind
+
+
+class scoped(object):
+    """Context manager: arm a fault for the duration of a block, then
+    disarm that site and reset its count. Usage::
+
+        with faults.scoped("io.record_read", nth=2, kind="transient"):
+            ...
+    """
+
+    def __init__(self, site, **kwargs):
+        self.site = site
+        self.kwargs = kwargs
+
+    def __enter__(self):
+        inject(self.site, **self.kwargs)
+        return self
+
+    def __exit__(self, *exc):
+        clear(self.site)
+        return False

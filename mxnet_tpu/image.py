@@ -189,9 +189,17 @@ class ImageIter(mxio.DataIter):
                  path_imgrec=None, path_imglist=None, path_root="",
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name="data", label_name="softmax_label",
+                 retry_policy=None, skip_corrupt=False, data_health=None,
                  **kwargs):
         super().__init__(batch_size)
         assert len(data_shape) == 3
+        # fault tolerance (docs/robustness.md): transient read failures are
+        # retried with bounded backoff; corrupt records are skipped with a
+        # DataHealth counter when skip_corrupt=True, else raise
+        self.retry_policy = retry_policy or mxio.RetryPolicy()
+        self.skip_corrupt = bool(skip_corrupt)
+        self.data_health = (data_health if data_health is not None
+                            else mxio.DataHealth(parent=mxio.DATA_HEALTH))
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
         self.label_width = label_width
@@ -247,16 +255,41 @@ class ImageIter(mxio.DataIter):
             _random.np_rng().shuffle(self.seq)
         self.cur = 0
 
-    def _read_one(self, key):
+    def _read_raw(self, key):
+        """The IO phase: record/file bytes + label. Transient failures here
+        (OSError, injected transients at site ``io.record_read``) are
+        retried by :meth:`_read_one`; exhaustion raises with the site name
+        and attempt count."""
+        from . import faults as _faults
+        _faults.fire("io.record_read")
         if self.record is not None:
-            s = self.record.read_idx(key)
-            header, img_bytes = recordio.unpack(s)
-            label = header.label
+            try:
+                s = self.record.read_idx(key)
+                header, img_bytes = recordio.unpack(s)
+            except OSError:
+                raise  # transient IO: retried by the policy
+            except Exception as e:
+                # record-level damage (truncated record, bad magic, header
+                # unpack) is as permanent as a bad JPEG: same skip path
+                raise mxio.CorruptRecordError(
+                    "corrupt record %r: %s: %s"
+                    % (key, type(e).__name__, e))
+            return header.label, img_bytes
+        label, fname = self.imglist[key]
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return label, f.read()
+
+    def _read_one(self, key):
+        label, img_bytes = mxio.retry_call(
+            lambda: self._read_raw(key), "io.record_read",
+            self.retry_policy, self.data_health)
+        try:
             img = imdecode(img_bytes).asnumpy()
-        else:
-            label, fname = self.imglist[key]
-            with open(os.path.join(self.path_root, fname), "rb") as f:
-                img = imdecode(f.read()).asnumpy()
+        except Exception as e:
+            # undecodable bytes are permanent: retrying cannot help
+            raise mxio.CorruptRecordError(
+                "corrupt image record %r: %s: %s"
+                % (key, type(e).__name__, e))
         for aug in self.aug_list:
             img = aug(img)
         # HWC -> CHW
@@ -272,11 +305,26 @@ class ImageIter(mxio.DataIter):
             raise StopIteration
         data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
         labels = np.zeros((self.batch_size, self.label_width), np.float32)
-        for i in range(self.batch_size):
-            img, label = self._read_one(self.seq[self.cur + i])
+        i = 0
+        while i < self.batch_size:
+            if self.cur >= len(self.seq):
+                # corrupt-skips ate into the final batch: drop the partial
+                raise StopIteration
+            key = self.seq[self.cur]
+            self.cur += 1
+            try:
+                img, label = self._read_one(key)
+            except mxio.CorruptRecordError as e:
+                if not self.skip_corrupt:
+                    raise
+                self.data_health.record_skip("io.record_read", e)
+                import logging
+                logging.warning("ImageIter: skipping %s", e)
+                continue
             data[i] = img
-            labels[i] = np.asarray(label, np.float32).reshape(-1)[:self.label_width]
-        self.cur += self.batch_size
+            labels[i] = np.asarray(label,
+                                   np.float32).reshape(-1)[:self.label_width]
+            i += 1
         label_arr = labels[:, 0] if self.label_width == 1 else labels
         return mxio.DataBatch(data=[data], label=[label_arr],
                               pad=0, index=None)

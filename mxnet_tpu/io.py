@@ -9,13 +9,156 @@ stage 8).
 """
 from __future__ import annotations
 
+import hashlib
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
 
 from .base import MXNetError
 from .ndarray import NDArray, array
+
+
+# ---------------------------------------------------------------------------
+# fault tolerance for the data plane (docs/robustness.md): a bounded
+# exponential-backoff retry for transient read failures, a skip-with-counter
+# path for corrupt records, and a DataHealth stat surfacing both.
+# ---------------------------------------------------------------------------
+
+class CorruptRecordError(MXNetError):
+    """A record that decoded/parsed as garbage (NOT transient: retrying the
+    same bytes cannot help; iterators either skip it or raise)."""
+
+
+class DataHealth(object):
+    """Thread-safe counters for data-pipeline degradation.
+
+    Every retry, skipped corrupt record and hard failure is recorded here
+    (and mirrored into the process-global ``io.DATA_HEALTH`` aggregate), so
+    a training run can report "healthy" vs "limping on retries" instead of
+    silently eating IO errors.
+    """
+
+    def __init__(self, parent=None):
+        self._lock = threading.Lock()
+        self._parent = parent
+        self.retries = 0
+        self.skipped_records = 0
+        self.failures = 0
+        self.last_error = None
+
+    def record_retry(self, site, exc):
+        with self._lock:
+            self.retries += 1
+            self.last_error = "%s: %s" % (site, exc)
+        if self._parent is not None:
+            self._parent.record_retry(site, exc)
+
+    def record_skip(self, site, exc):
+        with self._lock:
+            self.skipped_records += 1
+            self.last_error = "%s: %s" % (site, exc)
+        if self._parent is not None:
+            self._parent.record_skip(site, exc)
+
+    def record_failure(self, site, exc):
+        with self._lock:
+            self.failures += 1
+            self.last_error = "%s: %s" % (site, exc)
+        if self._parent is not None:
+            self._parent.record_failure(site, exc)
+
+    def report(self):
+        with self._lock:
+            return {"retries": self.retries,
+                    "skipped_records": self.skipped_records,
+                    "failures": self.failures,
+                    "last_error": self.last_error}
+
+    def reset(self):
+        with self._lock:
+            self.retries = 0
+            self.skipped_records = 0
+            self.failures = 0
+            self.last_error = None
+
+    def __repr__(self):
+        return "DataHealth(%r)" % (self.report(),)
+
+
+#: process-global aggregate every per-iterator DataHealth mirrors into
+DATA_HEALTH = DataHealth()
+
+
+class RetryPolicy(object):
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``delay(attempt, site)``: ``base_delay * 2**(attempt-1)`` capped at
+    ``max_delay``, plus up to ``jitter`` fraction derived from a hash of
+    (worker rank, site, attempt) — repeatable run-to-run for a given rank
+    layout, yet de-correlated across sites AND workers (N workers retrying
+    the same site don't thundering-herd a recovering filesystem).
+    """
+
+    def __init__(self, max_retries=3, base_delay=0.01, max_delay=0.5,
+                 jitter=0.5):
+        self.max_retries = int(max_retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        import os
+        self._worker_salt = os.environ.get("MXTPU_RANK", "0")
+
+    def delay(self, attempt, site=""):
+        d = min(self.base_delay * (2.0 ** max(0, attempt - 1)),
+                self.max_delay)
+        if self.jitter and d > 0:
+            h = hashlib.sha256(("%s/%s#%d" % (self._worker_salt, site,
+                                              attempt)).encode())
+            frac = int.from_bytes(h.digest()[:4], "big") / float(1 << 32)
+            d *= 1.0 + self.jitter * frac
+        return d
+
+
+#: OSError subclasses that retrying cannot fix — surface them immediately
+#: with their real cause instead of burning the budget
+_PERMANENT_OSERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                       NotADirectoryError)
+
+
+def _transient_types():
+    from . import faults as _faults
+    return (_faults.InjectedTransientFault, OSError)
+
+
+def retry_call(fn, site, policy=None, health=None):
+    """Call ``fn`` with the policy's bounded retry on transient errors
+    (OSError and injected transient faults). Exhausting the budget raises
+    :class:`MXNetError` naming the site and attempt count; non-transient
+    errors — including permanent OSErrors like FileNotFoundError —
+    propagate untouched."""
+    policy = policy or RetryPolicy()
+    health = health or DATA_HEALTH
+    transient = _transient_types()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except _PERMANENT_OSERRORS:
+            raise
+        except transient as e:
+            attempt += 1
+            if attempt > policy.max_retries:
+                health.record_failure(site, e)
+                raise MXNetError(
+                    "%s: giving up after %d attempts (retry budget %d "
+                    "exhausted): %s" % (site, attempt, policy.max_retries,
+                                        e)) from e
+            health.record_retry(site, e)
+            d = policy.delay(attempt, site)
+            if d > 0:
+                time.sleep(d)
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -313,7 +456,8 @@ class SuperBatchIter(DataIter):
     """
 
     def __init__(self, base, k, prefetch=True, queue_depth=2,
-                 last_group_handle="partial"):
+                 last_group_handle="partial", retry_policy=None,
+                 data_health=None):
         super().__init__(getattr(base, "batch_size", 0))
         if k < 1:
             raise MXNetError("superbatch: k must be >= 1, got %r" % (k,))
@@ -323,6 +467,9 @@ class SuperBatchIter(DataIter):
         self.base = base
         self.k = int(k)
         self.last_group_handle = last_group_handle
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.data_health = (data_health if data_health is not None
+                            else DataHealth(parent=DATA_HEALTH))
         self._prefetch = prefetch
         self._depth = max(1, int(queue_depth))
         self._queue = None
@@ -353,13 +500,24 @@ class SuperBatchIter(DataIter):
         return self._stacked_descs(self.base.provide_label)
 
     # -- assembly ------------------------------------------------------
+    def _pull_one(self):
+        """One batch from the base iterator, with transient read failures
+        retried per the policy (fault site ``io.batch_read``)."""
+        from . import faults as _faults
+        next_host = getattr(self.base, "next_host", None)
+
+        def pull():
+            _faults.fire("io.batch_read")
+            return next_host() if next_host is not None else self.base.next()
+
+        return retry_call(pull, "io.batch_read", self.retry_policy,
+                          self.data_health)
+
     def _pull_group(self):
         group = []
-        next_host = getattr(self.base, "next_host", None)
         for _ in range(self.k):
             try:
-                group.append(next_host() if next_host is not None
-                             else self.base.next())
+                group.append(self._pull_one())
             except StopIteration:
                 break
         if not group or (len(group) < self.k
@@ -367,14 +525,23 @@ class SuperBatchIter(DataIter):
             return None
         return group
 
-    @staticmethod
-    def _stack(parts):
+    def _stack(self, parts):
         """One stacked array per slot; host parts take a single np.stack +
         device put (ONE H2D for the whole superbatch slot), device parts
-        stack on device."""
+        stack on device. The device transfer (fault site ``io.h2d``) is
+        retried like any transient IO: a flaky transfer costs a retry, not
+        the run."""
+        from . import faults as _faults
         raw = [p.data if isinstance(p, NDArray) else p for p in parts]
         if all(isinstance(r, np.ndarray) for r in raw):
-            return array(np.stack(raw))
+            stacked = np.stack(raw)
+
+            def land():
+                _faults.fire("io.h2d")
+                return array(stacked)
+
+            return retry_call(land, "io.h2d", self.retry_policy,
+                              self.data_health)
         import jax.numpy as jnp
         return NDArray(jnp.stack([jnp.asarray(r) for r in raw]))
 
@@ -404,7 +571,10 @@ class SuperBatchIter(DataIter):
         wr = weakref.ref(self)
 
         def produce(stop, q):
+            from . import faults as _faults
             while not stop.is_set():
+                if _faults.fire("superbatch.producer") == "die":
+                    return  # simulated abrupt thread death (no sentinel)
                 it = wr()
                 if it is None:
                     return
@@ -447,6 +617,24 @@ class SuperBatchIter(DataIter):
         except Exception:
             pass
 
+    def _queue_get_checked(self):
+        """Blocking queue get that detects a dead producer: a thread that
+        died without delivering its sentinel (crash, injected death) would
+        otherwise block the training loop forever. Raises MXNetError with
+        the site name instead."""
+        import queue as _queue
+        while True:
+            try:
+                return self._queue.get(timeout=0.1)
+            except _queue.Empty:
+                t = self._thread
+                if t is None or not t.is_alive():
+                    self._done = True
+                    raise MXNetError(
+                        "superbatch.producer: prefetch thread died without "
+                        "delivering a batch (DataHealth=%r)"
+                        % (self.data_health.report(),))
+
     # -- DataIter interface --------------------------------------------
     def reset(self):
         if self._prefetch:
@@ -471,7 +659,7 @@ class SuperBatchIter(DataIter):
         if self._done:
             raise StopIteration
         if self._prefetch:
-            item = self._queue.get()
+            item = self._queue_get_checked()
         else:
             group = self._pull_group()
             item = self._assemble(group) if group else None

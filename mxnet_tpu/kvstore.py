@@ -22,11 +22,73 @@ substrate:
 """
 from __future__ import annotations
 
+import logging
+import os
 import pickle
+import threading
+import time
 
 from .base import MXNetError, NotImplementedForTPU
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
+
+
+class KVStoreTimeoutError(MXNetError):
+    """A kvstore operation blew its configured deadline (or an injected
+    message drop). ``started`` records whether the underlying op had begun:
+    pre-op failures (drops) are retried against the configured budget;
+    a started-but-stuck op escalates immediately — its abandoned watchdog
+    thread may still be participating in a collective, and re-entering the
+    same barrier would corrupt the rendezvous."""
+
+    def __init__(self, msg, started=False):
+        super().__init__(msg)
+        self.started = started
+
+
+class WorkerLostError(MXNetError):
+    """Raised by the degradation policy when peers stay dead across
+    consecutive health checks: BSP training cannot make progress, so the
+    run should checkpoint (already done at strike 2) and surface."""
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise MXNetError("%s must be a number, got %r" % (name, v))
+
+
+def _run_with_timeout(fn, timeout, site):
+    """Run an IDEMPOTENT op under a watchdog: if it makes no progress
+    within ``timeout`` seconds, raise KVStoreTimeoutError (the worker
+    thread is abandoned — safe only because the op is idempotent and the
+    caller retries or escalates)."""
+    result = {}
+    done = threading.Event()
+
+    def runner():
+        try:
+            result["v"] = fn()
+        except BaseException as e:  # surfaced to the caller below
+            result["e"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, name="mxtpu-kv-watchdog",
+                         daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise KVStoreTimeoutError(
+            "%s: no progress after %.1fs deadline; a peer may be dead or "
+            "partitioned — check num_dead_node() and resume from the last "
+            "checkpoint" % (site, timeout), started=True)
+    if "e" in result:
+        raise result["e"]
+    return result.get("v")
 
 
 class KVStore(object):
@@ -36,6 +98,125 @@ class KVStore(object):
         self._type = kv_type
         self._store = {}
         self._updater = None
+        # fault policy (docs/robustness.md): env-seeded, overridable via
+        # set_fault_policy. timeout=None disables deadlines.
+        self._timeout = _env_float("MXTPU_KV_TIMEOUT", None)
+        self._retries = int(_env_float("MXTPU_KV_RETRIES", 2))
+        self._backoff = _env_float("MXTPU_KV_BACKOFF", 0.02)
+        self._backoff_max = _env_float("MXTPU_KV_BACKOFF_MAX", 0.5)
+        self._health_interval = _env_float("MXTPU_KV_HEALTH_INTERVAL", 10.0)
+        self._dead_timeout = _env_float("MXTPU_KV_DEAD_TIMEOUT", 60.0)
+        self._dead_strikes = 0
+        self._last_health = None
+
+    def set_fault_policy(self, timeout="unset", retries=None, backoff=None,
+                         backoff_max=None, health_interval=None,
+                         dead_timeout=None):
+        """Configure op deadlines, retry budget, backoff and health-check
+        cadence (env defaults: MXTPU_KV_TIMEOUT / _RETRIES / _BACKOFF /
+        _BACKOFF_MAX / _HEALTH_INTERVAL / _DEAD_TIMEOUT)."""
+        if timeout != "unset":
+            self._timeout = timeout
+        if retries is not None:
+            self._retries = int(retries)
+        if backoff is not None:
+            self._backoff = float(backoff)
+        if backoff_max is not None:
+            self._backoff_max = float(backoff_max)
+        if health_interval is not None:
+            self._health_interval = float(health_interval)
+        if dead_timeout is not None:
+            self._dead_timeout = float(dead_timeout)
+
+    def _robust(self, op, fn, idempotent=False):
+        """Run a kvstore op with the configured retry/backoff and (for
+        idempotent ops) watchdog deadline. Only PRE-OP failures are
+        retried — injected transients and drops, which fire before the op
+        runs; budget exhaustion raises MXNetError naming the op and
+        attempt count. A started-but-stuck op (watchdog timeout) escalates
+        immediately: its abandoned thread may still be inside a
+        distributed barrier, and re-entering the collective would corrupt
+        the rendezvous. Non-idempotent ops (push/pull) that complete but
+        exceed the deadline only warn: retrying a completed push would
+        double-apply the gradient."""
+        from . import faults as _faults
+        site = "kvstore.%s" % op
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                act = _faults.fire(site)
+                if act == "drop":
+                    raise KVStoreTimeoutError(
+                        "%s: message dropped (injected)" % site)
+                if idempotent and self._timeout:
+                    return _run_with_timeout(fn, self._timeout, site)
+                t0 = time.monotonic()
+                out = fn()
+                elapsed = time.monotonic() - t0
+                if self._timeout and elapsed > self._timeout:
+                    logging.warning(
+                        "%s completed but took %.2fs (deadline %.2fs) — "
+                        "peers may be degrading; check num_dead_node()",
+                        site, elapsed, self._timeout)
+                return out
+            except (KVStoreTimeoutError,
+                    _faults.InjectedTransientFault) as e:
+                if getattr(e, "started", False):
+                    raise MXNetError(
+                        "%s timed out after it started (attempt %d): %s"
+                        % (site, attempt, e)) from e
+                if attempt > self._retries:
+                    raise MXNetError(
+                        "%s failed after %d attempts (retry budget %d "
+                        "exhausted): %s" % (site, attempt, self._retries,
+                                            e)) from e
+                delay = min(self._backoff * (2.0 ** (attempt - 1)),
+                            self._backoff_max)
+                logging.warning("%s: transient failure (attempt %d/%d), "
+                                "retrying in %.3fs: %s", site, attempt,
+                                self._retries + 1, delay, e)
+                if delay > 0:
+                    time.sleep(delay)
+
+    def check_health(self, on_degraded=None, force=False):
+        """The dead-node degradation policy: feed ``num_dead_node`` into a
+        strike counter — strike 1 warns, strike 2 warns and runs
+        ``on_degraded`` (fit passes an emergency-checkpoint closure),
+        strike 3+ raises :class:`WorkerLostError`. A healthy scan resets
+        the strikes. Scans are throttled to one per
+        ``MXTPU_KV_HEALTH_INTERVAL`` seconds unless ``force``."""
+        from . import faults as _faults
+        now = time.monotonic()
+        if (not force and self._last_health is not None
+                and now - self._last_health < self._health_interval):
+            return 0
+        self._last_health = now
+        dead = self.num_dead_node(0, timeout_sec=self._dead_timeout)
+        act = _faults.fire("kvstore.dead_node")
+        if act and isinstance(act, str) and act.startswith("dead:"):
+            dead = max(dead, int(act.split(":", 1)[1]))
+        if not dead:
+            self._dead_strikes = 0
+            return 0
+        self._dead_strikes += 1
+        if self._dead_strikes == 1:
+            logging.warning(
+                "kvstore: %d dead worker(s) detected (strike 1/3: warn)",
+                dead)
+        elif self._dead_strikes == 2:
+            logging.warning(
+                "kvstore: %d worker(s) still dead (strike 2/3: emergency "
+                "checkpoint)", dead)
+            if on_degraded is not None:
+                on_degraded()
+        else:
+            raise WorkerLostError(
+                "%d dead worker(s) across %d consecutive health checks; "
+                "BSP training cannot progress — restart from the last "
+                "checkpoint (resume='auto') with a healthy worker set"
+                % (dead, self._dead_strikes))
+        return dead
 
     @property
     def type(self):
@@ -68,6 +249,9 @@ class KVStore(object):
         return merged
 
     def push(self, key, value, priority=0):
+        self._robust("push", lambda: self._do_push(key, value, priority))
+
+    def _do_push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         for k, vlist in zip(keys, values):
             if k not in self._store:
@@ -88,6 +272,9 @@ class KVStore(object):
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
+        self._robust("pull", lambda: self._do_pull(key, out, priority))
+
+    def _do_pull(self, key, out, priority=0):
         keys, outs = _key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -109,19 +296,27 @@ class KVStore(object):
     def _barrier(self):
         pass
 
-    barrier = _barrier
+    def barrier(self):
+        """Block until every worker arrives (no-op single-process).
+        Idempotent, so it runs under the watchdog deadline and retry
+        budget when MXTPU_KV_TIMEOUT is set."""
+        self._robust("barrier", self._barrier, idempotent=True)
 
     def save_optimizer_states(self, fname):
+        """Returns the serialized bytes (see Module.save_optimizer_states:
+        checkpoint manifests checksum the intended payload)."""
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
-        with open(fname, "wb") as fout:
-            fout.write(self._updater.get_states())
+        from .model import atomic_write_bytes
+        data = self._updater.get_states()
+        atomic_write_bytes(fname, data)
+        return data
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot load states for distributed training")
-        with open(fname, "rb") as fin:
-            self._updater.set_states(fin.read())
+        from .model import apply_optimizer_states
+        apply_optimizer_states(self._updater.set_states, fname)
 
     def num_dead_node(self, node_id, timeout_sec=60):
         """ref: kvstore_dist.h:159-168 — dead-node count surfaced to user
@@ -141,9 +336,12 @@ class _Heartbeat(object):
 
     KEY = "mxtpu_hb/%d"
 
-    def __init__(self, rank, interval=2.0):
+    def __init__(self, rank, interval=2.0, startup_grace=None):
         self.rank = rank
         self.interval = interval
+        self.startup_grace = startup_grace
+        self._started = time.time()
+        self._seen = set()  # ranks whose beat we have read at least once
         self._stop = None
         client = self._client()
         if client is None:
@@ -185,21 +383,29 @@ class _Heartbeat(object):
             pass
 
     def dead_nodes(self, size, timeout_sec):
-        import time
         client = self._client()
         if client is None or size <= 1:
             return 0
         now = time.time()
+        # a peer that has never published is "not up yet", not dead: during
+        # rendezvous the slower ranks haven't stamped their first beat, and
+        # counting them dead made every startup look like an outage. Only
+        # after the startup grace (default: the staleness timeout itself)
+        # does silence-from-birth count as death.
+        grace = (self.startup_grace if self.startup_grace is not None
+                 else timeout_sec)
         dead = 0
         for r in range(size):
             if r == self.rank:
                 continue
             try:
                 v = client.key_value_try_get(self.KEY % r)
+                self._seen.add(r)
                 if now - float(v) > timeout_sec:
                     dead += 1
-            except Exception:        # never published -> dead or not up yet
-                dead += 1
+            except Exception:        # no beat published for this rank
+                if r in self._seen or now - self._started > grace:
+                    dead += 1
         return dead
 
     def stop(self):
@@ -258,8 +464,6 @@ class KVStoreDistSync(KVStore):
             import jax
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
-
-    barrier = _barrier
 
     # ------------------------------------------------------------------
     def _cross_sum(self, value):

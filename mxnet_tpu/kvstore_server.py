@@ -14,15 +14,29 @@ import os
 
 def _init_distributed():
     """Initialize the jax.distributed control plane from MXTPU_* env vars
-    (set by tools/launch.py — the tracker-rendezvous replacement)."""
+    (set by tools/launch.py — the tracker-rendezvous replacement).
+
+    MXTPU_INIT_TIMEOUT (seconds) bounds the rendezvous: a mis-launched pod
+    (wrong coordinator address, dead rank 0) fails fast with jax's timeout
+    error instead of hanging the whole job forever.
+    """
     coord = os.environ.get("MXTPU_COORD")
     if not coord:
         return False
     import jax
-    jax.distributed.initialize(
+    kwargs = dict(
         coordinator_address=coord,
         num_processes=int(os.environ.get("MXTPU_NPROC", "1")),
         process_id=int(os.environ.get("MXTPU_RANK", "0")))
+    timeout = os.environ.get("MXTPU_INIT_TIMEOUT")
+    if timeout:
+        try:
+            jax.distributed.initialize(
+                initialization_timeout=int(float(timeout)), **kwargs)
+            return True
+        except TypeError:
+            pass  # older jaxlib without the kwarg: fall through
+    jax.distributed.initialize(**kwargs)
     return True
 
 

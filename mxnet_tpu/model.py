@@ -4,7 +4,11 @@ checkpointing, FeedForward :387).
 """
 from __future__ import annotations
 
+import glob
+import hashlib
+import json
 import logging
+import os
 from collections import namedtuple
 
 import numpy as np
@@ -79,30 +83,409 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         updater(index, grad, arg)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save symbol JSON + params (ref: model.py save_checkpoint)."""
-    if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+# ---------------------------------------------------------------------------
+# fault-tolerant checkpointing (docs/robustness.md)
+#
+# Every checkpoint file lands via write-to-temp + fsync + rename, so a crash
+# mid-save can never leave a half-written file under the live name; a
+# checksummed JSON manifest binds the file set to a training cursor
+# (epoch / batches / optimizer clock / RNG) so load can PROVE a checkpoint
+# is whole before trusting it, and fall back to the previous one when not.
+# ---------------------------------------------------------------------------
+
+CKPT_VERSION = 1
+
+
+def _fsync_dir(dirname):
+    """Make a rename durable (POSIX: the directory entry needs its own
+    fsync). Best-effort on filesystems without directory fds."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path, data):
+    """Atomically publish ``data`` at ``path``: temp file + fsync + rename.
+
+    Fault sites: ``checkpoint.write`` (before any byte is written — a raise
+    leaves the live file untouched), ``checkpoint.write.mid`` (after half
+    the payload — a raise leaves only an orphaned ``.tmp-*``, never a
+    truncated live file). The injected ``truncate`` kind *does* publish a
+    torn file, simulating power loss between rename and data reaching disk;
+    the manifest checksum is what catches it at load time.
+    """
+    from . import faults as _faults
+    path = os.fspath(path)
+    act = _faults.fire("checkpoint.write")
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    if act == "truncate":
+        data = data[:max(1, len(data) // 2)]
+    try:
+        with open(tmp, "wb") as f:
+            half = len(data) // 2
+            f.write(data[:half])
+            _faults.fire("checkpoint.write.mid")
+            f.write(data[half:])
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def apply_optimizer_states(set_states, fname):
+    """Read an optimizer-states file and feed it to ``set_states``, turning
+    raw read errors and unpickle failures into actionable MXNetErrors (one
+    shared recovery-hint wording for the KVStore and Module paths)."""
+    try:
+        with open(fname, "rb") as fin:
+            data = fin.read()
+    except OSError as e:
+        raise MXNetError(
+            "cannot read optimizer states %r: %s — save them with "
+            "save_optimizer_states (or Module.save_checkpoint("
+            "save_optimizer_states=True)) before loading" % (fname, e))
+    try:
+        set_states(data)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError(
+            "optimizer states file %r is corrupt or truncated (%s: %s); "
+            "re-save it or fall back to an earlier checkpoint"
+            % (fname, type(e).__name__, e))
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _param_save_bytes(arg_params, aux_params):
+    """Serialize params to the dmlc .params byte layout (what nd.save
+    writes), as bytes for the atomic writer."""
+    from . import dmlc_serial
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    names = list(save_dict.keys())
+    arrs = [save_dict[k].asnumpy() if hasattr(save_dict[k], "asnumpy")
+            else np.asarray(save_dict[k]) for k in names]
+    return dmlc_serial.dumps(arrs, names)
+
+
+def _split_param_dict(save_dict, fname):
+    """Split a loaded {prefix:name -> NDArray} dict into (arg, aux),
+    rejecting malformed keys with an error that names the file and key."""
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        if ":" not in k:
+            raise MXNetError(
+                "invalid parameter file %r: key %r is malformed (expected "
+                "'arg:<name>' or 'aux:<name>')" % (fname, k))
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError(
+                "invalid parameter file %r: key %r has unknown prefix %r "
+                "(expected 'arg' or 'aux')" % (fname, k, tp))
+    return arg_params, aux_params
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save symbol JSON + params (ref: model.py save_checkpoint).
+
+    Both files land atomically (temp + fsync + rename): a crash mid-save
+    leaves the previous checkpoint intact, never a truncated live file.
+    """
+    if symbol is not None:
+        atomic_write_bytes("%s-symbol.json" % prefix,
+                           symbol.tojson().encode())
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    atomic_write_bytes(param_name, _param_save_bytes(arg_params, aux_params))
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
 def load_checkpoint(prefix, epoch):
-    """Load (symbol, arg_params, aux_params) (ref: model.py load_checkpoint)."""
+    """Load (symbol, arg_params, aux_params) (ref: model.py load_checkpoint).
+
+    Malformed keys (no ``arg:``/``aux:`` prefix) raise :class:`MXNetError`
+    naming the offending file and key instead of being silently dropped.
+    """
     symbol = sym.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        if tp == "aux":
-            aux_params[name] = v
+    fname = "%s-%04d.params" % (prefix, epoch)
+    save_dict = nd.load(fname)
+    arg_params, aux_params = _split_param_dict(save_dict, fname)
     return (symbol, arg_params, aux_params)
+
+
+class CheckpointState(object):
+    """A validated checkpoint loaded by :class:`CheckpointManager`."""
+
+    __slots__ = ("tag", "epoch", "batches_done", "num_update", "arg_params",
+                 "aux_params", "opt_states_file", "rng", "metric_state",
+                 "manifest")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    def restore_rng(self):
+        """Restore the global functional RNG stream to its save-time value."""
+        if not self.rng:
+            return
+        import jax
+        from . import random as _random
+        data = np.asarray(self.rng["data"],
+                          dtype=np.dtype(self.rng["dtype"]))
+        _random.set_state(jax.random.wrap_key_data(
+            data.reshape(self.rng["shape"])))
+
+
+class CheckpointManager(object):
+    """Atomic, checksummed, self-validating training checkpoints.
+
+    One checkpoint = a tag ``e<epoch>-b<batches>`` owning
+    ``<prefix>-<tag>.params`` (+ ``.states`` when an optimizer is live) and
+    ``<prefix>-<tag>.manifest.json`` holding sha256/size for each file plus
+    the training cursor (epoch, batches_done, optimizer update count, RNG
+    key, metric partial sums). ``<prefix>-latest`` points at the newest tag;
+    the last ``keep`` checkpoints are retained, older ones pruned.
+
+    ``load_latest`` validates checksums and falls back to the previous
+    valid checkpoint (with a warning) when the newest is truncated or
+    corrupt — the recovery contract the fault-injection suite pins down.
+    """
+
+    def __init__(self, prefix, keep=3, logger=None, save_rng=True):
+        self.prefix = os.fspath(prefix)
+        self.keep = max(1, int(keep))
+        self.logger = logger or logging
+        self.save_rng = save_rng
+        d = os.path.dirname(os.path.abspath(self.prefix))
+        if d and not os.path.isdir(d):
+            os.makedirs(d, exist_ok=True)
+
+    # -- naming --------------------------------------------------------
+    @staticmethod
+    def _tag(epoch, batches_done):
+        return "e%04d-b%08d" % (epoch, batches_done)
+
+    def _file(self, tag, suffix):
+        return "%s-%s.%s" % (self.prefix, tag, suffix)
+
+    @property
+    def latest_path(self):
+        return "%s-latest" % self.prefix
+
+    # -- save ----------------------------------------------------------
+    def save(self, module, epoch, batches_done, metric=None):
+        """Checkpoint a module's full training state at a batch boundary.
+
+        ``batches_done`` is the number of completed batches within
+        ``epoch`` (0 = clean epoch start). Returns the tag written.
+        """
+        tag = self._tag(epoch, batches_done)
+        files = {}
+
+        arg_params, aux_params = module.get_params()
+        params_f = self._file(tag, "params")
+        params_bytes = _param_save_bytes(arg_params or {}, aux_params or {})
+        atomic_write_bytes(params_f, params_bytes)
+        # hash the INTENDED payload, not a re-read of the file: a write
+        # torn between publish and durability then shows up as a
+        # size/checksum mismatch at load time instead of validating
+        files["params"] = {
+            "name": os.path.basename(params_f),
+            "size": len(params_bytes),
+            "sha256": hashlib.sha256(params_bytes).hexdigest(),
+        }
+
+        if getattr(module, "optimizer_initialized", False):
+            states_f = self._file(tag, "states")
+            states_bytes = module.save_optimizer_states(states_f)
+            if not isinstance(states_bytes, (bytes, bytearray)):
+                # module whose save doesn't return the payload: re-read
+                # (loses torn-write detection for this file only)
+                with open(states_f, "rb") as f:
+                    states_bytes = f.read()
+            files["states"] = {
+                "name": os.path.basename(states_f),
+                "size": len(states_bytes),
+                "sha256": hashlib.sha256(bytes(states_bytes)).hexdigest(),
+            }
+
+        if getattr(module, "symbol", None) is not None:
+            sym_f = "%s-symbol.json" % self.prefix
+            if not os.path.exists(sym_f):
+                atomic_write_bytes(sym_f, module.symbol.tojson().encode())
+
+        opt = getattr(module, "_optimizer", None)
+        manifest = {
+            "version": CKPT_VERSION,
+            "tag": tag,
+            "epoch": int(epoch),
+            "batches_done": int(batches_done),
+            "num_update": int(getattr(opt, "num_update", 0) or 0),
+            "files": files,
+        }
+        if self.save_rng:
+            import jax
+            from . import random as _random
+            kd = np.asarray(jax.random.key_data(_random.get_state()))
+            manifest["rng"] = {"dtype": str(kd.dtype),
+                               "shape": list(kd.shape),
+                               "data": kd.reshape(-1).tolist()}
+        ms = self._metric_state(metric)
+        if ms is not None:
+            manifest["metric"] = ms
+        atomic_write_bytes(self._file(tag, "manifest.json"),
+                           json.dumps(manifest, indent=1).encode())
+        atomic_write_bytes(self.latest_path, tag.encode())
+        self._prune()
+        self.logger.info("Saved checkpoint %s (epoch %d, %d batches done)",
+                         tag, epoch, batches_done)
+        return tag
+
+    @staticmethod
+    def _metric_state(metric):
+        """Snapshot an EvalMetric's partial sums when its state is the
+        plain (sum_metric, num_inst) pair; composite metrics skip."""
+        if metric is None or not hasattr(metric, "sum_metric"):
+            return None
+        s, n = metric.sum_metric, metric.num_inst
+        try:
+            json.dumps([s, n])
+        except (TypeError, ValueError):
+            return None
+        return [s, n]
+
+    # -- load ----------------------------------------------------------
+    def list_tags(self):
+        """All tags with a manifest on disk, oldest -> newest."""
+        # glob.escape: a prefix containing [ ? * must not read as a glob
+        # pattern (it would silently disable resume and retention)
+        pat = "%s-*.manifest.json" % glob.escape(self.prefix)
+        plen = len(self.prefix) + 1
+        tags = [p[plen:-len(".manifest.json")] for p in glob.glob(pat)]
+        return sorted(tags)
+
+    def load(self, tag):
+        """Load and VALIDATE one checkpoint; raises MXNetError naming the
+        file and failure (missing / size mismatch / checksum mismatch /
+        unparseable manifest) when it is not whole."""
+        man_f = self._file(tag, "manifest.json")
+        try:
+            with open(man_f, "rb") as f:
+                manifest = json.loads(f.read().decode())
+        except OSError as e:
+            raise MXNetError("checkpoint %s: cannot read manifest %r: %s"
+                             % (tag, man_f, e))
+        except ValueError as e:
+            raise MXNetError("checkpoint %s: manifest %r is corrupt: %s"
+                             % (tag, man_f, e))
+        if manifest.get("version", 0) > CKPT_VERSION:
+            raise MXNetError(
+                "checkpoint %s: manifest version %s is newer than this "
+                "build supports (%d)" % (tag, manifest.get("version"),
+                                         CKPT_VERSION))
+        base_dir = os.path.dirname(os.path.abspath(self.prefix))
+        paths = {}
+        for role, info in manifest.get("files", {}).items():
+            path = os.path.join(base_dir, info["name"])
+            if not os.path.exists(path):
+                raise MXNetError("checkpoint %s: file %r is missing"
+                                 % (tag, path))
+            size = os.path.getsize(path)
+            if size != info["size"]:
+                raise MXNetError(
+                    "checkpoint %s: file %r is truncated (%d bytes, "
+                    "manifest says %d)" % (tag, path, size, info["size"]))
+            digest = _sha256_file(path)
+            if digest != info["sha256"]:
+                raise MXNetError(
+                    "checkpoint %s: checksum mismatch for %r (sha256 %s, "
+                    "manifest says %s)" % (tag, path, digest,
+                                           info["sha256"]))
+            paths[role] = path
+        if "params" not in paths:
+            raise MXNetError("checkpoint %s: manifest lists no params file"
+                             % tag)
+        save_dict = nd.load(paths["params"])
+        arg_params, aux_params = _split_param_dict(save_dict,
+                                                   paths["params"])
+        return CheckpointState(
+            tag=tag, epoch=int(manifest["epoch"]),
+            batches_done=int(manifest["batches_done"]),
+            num_update=int(manifest.get("num_update", 0)),
+            arg_params=arg_params, aux_params=aux_params,
+            opt_states_file=paths.get("states"),
+            rng=manifest.get("rng"), metric_state=manifest.get("metric"),
+            manifest=manifest)
+
+    def load_latest(self):
+        """Newest VALID checkpoint, or None. A corrupt/truncated newest
+        checkpoint is skipped with a warning and the previous valid one is
+        returned — the auto-resume entry point.
+
+        Tags are tried newest-first by cursor order; the ``latest`` pointer
+        is only a fallback (a crash between the manifest write and the
+        pointer write leaves the pointer one save behind — the newer
+        on-disk checkpoint must still win)."""
+        candidates = list(reversed(self.list_tags()))
+        try:
+            with open(self.latest_path) as f:
+                pointed = f.read().strip()
+            if pointed and pointed not in candidates:
+                candidates.append(pointed)
+        except OSError:
+            pass
+        for tag in candidates:
+            try:
+                return self.load(tag)
+            except MXNetError as e:
+                self.logger.warning(
+                    "checkpoint %s failed validation (%s); falling back to "
+                    "the previous checkpoint", tag, e)
+        return None
+
+    # -- retention -----------------------------------------------------
+    def _prune(self):
+        tags = self.list_tags()
+        for tag in tags[:-self.keep]:
+            man_f = self._file(tag, "manifest.json")
+            base_dir = os.path.dirname(os.path.abspath(self.prefix))
+            try:
+                with open(man_f, "rb") as f:
+                    manifest = json.loads(f.read().decode())
+                victims = [os.path.join(base_dir, i["name"])
+                           for i in manifest.get("files", {}).values()]
+            except (OSError, ValueError):
+                victims = [self._file(tag, "params"),
+                           self._file(tag, "states")]
+            for path in victims + [man_f]:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
 
 def _init_iter(X, y, batch_size, is_train=True):
@@ -197,7 +580,24 @@ class FeedForward(object):
                                   label_names=None, context=self.ctx)
             self._module.bind(data_shapes=data.provide_data,
                               label_shapes=None, for_training=False)
-            self._module.set_params(self.arg_params, self.aux_params or {})
+            # with label_names=None the symbol's label variable counts as a
+            # parameter the checkpoint never stores; inference ignores it,
+            # so ONLY label variables may be absent — a genuinely missing
+            # weight must still fail loudly, not predict garbage
+            data_names = set(d.name for d in data.provide_data)
+            missing = [n for n in self.symbol.list_arguments()
+                       if n not in data_names
+                       and n not in self._label_names()
+                       and n not in (self.arg_params or {})]
+            missing += [n for n in self.symbol.list_auxiliary_states()
+                        if n not in (self.aux_params or {})]
+            if missing:
+                raise MXNetError(
+                    "predict: loaded params are missing weight/aux "
+                    "state(s) %s — wrong or incomplete checkpoint?"
+                    % (missing,))
+            self._module.set_params(self.arg_params, self.aux_params or {},
+                                    allow_missing=True)
         out = self._module.predict(data, num_batch=num_batch, reset=reset)
         if isinstance(out, list):
             return [o.asnumpy() for o in out]

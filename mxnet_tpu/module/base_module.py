@@ -121,7 +121,9 @@ class BaseModule(object):
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, steps_per_dispatch=None):
+            monitor=None, steps_per_dispatch=None, resume=None,
+            checkpoint_prefix=None, checkpoint_every_n_batches=None,
+            checkpoint_keep=3):
         """The training loop (ref: base_module.py:368-519).
 
         ``steps_per_dispatch=k`` (default: ``engine.bulk_size()``, normally
@@ -133,10 +135,47 @@ class BaseModule(object):
         once per dispatch. Requires the fused fast path and an acc/ce-style
         metric; configurations that cannot bulk fall back to k=1 with a
         warning.
+
+        Fault tolerance (docs/robustness.md): ``checkpoint_prefix`` turns
+        on atomic checksummed checkpoints — every epoch end, plus every
+        ``checkpoint_every_n_batches`` completed batches (rounded to a
+        dispatch boundary under ``steps_per_dispatch``). ``resume='auto'``
+        restores the newest *valid* checkpoint (params, optimizer state,
+        lr/update clock, RNG stream, metric partial sums) and fast-forwards
+        the train iterator past the already-trained batches, so a killed
+        run re-launched with the same script continues bit-for-bit. The
+        last ``checkpoint_keep`` checkpoints are retained.
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         from .. import engine as _engine
+        ckpt_mgr = None
+        resume_state = None
+        if checkpoint_prefix is not None:
+            from ..model import CheckpointManager
+            ckpt_mgr = CheckpointManager(checkpoint_prefix,
+                                         keep=checkpoint_keep,
+                                         logger=self.logger)
+        if resume in ("auto", True):
+            if ckpt_mgr is None:
+                raise MXNetError("fit(resume=%r) requires checkpoint_prefix"
+                                 % (resume,))
+            resume_state = ckpt_mgr.load_latest()
+            if resume_state is None:
+                self.logger.info("resume='auto': no valid checkpoint under "
+                                 "%r, starting fresh", checkpoint_prefix)
+            else:
+                self.logger.info(
+                    "resuming from checkpoint %s (epoch %d, %d batches "
+                    "done)", resume_state.tag, resume_state.epoch,
+                    resume_state.batches_done)
+                arg_params = resume_state.arg_params
+                aux_params = resume_state.aux_params
+                force_init = True
+                begin_epoch = resume_state.epoch
+        elif resume not in (None, False):
+            raise MXNetError("resume must be 'auto' or None, got %r"
+                             % (resume,))
         if initializer is None:
             initializer = Uniform(0.01)
         self.bind(data_shapes=train_data.provide_data,
@@ -149,6 +188,8 @@ class BaseModule(object):
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if resume_state is not None:
+            self._apply_resume_state(resume_state)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
@@ -192,21 +233,52 @@ class BaseModule(object):
                 tic = time.time()
                 eval_metric.reset()
                 nbatch = -1
+                since_ckpt = 0
+                resume_skip = 0
+                if (resume_state is not None
+                        and epoch == resume_state.epoch
+                        and resume_state.batches_done > 0):
+                    # mid-epoch resume: replay the metric's partial sums and
+                    # fast-forward past the already-trained batches (the
+                    # iterator is consumed but nothing is computed)
+                    resume_skip = resume_state.batches_done
+                    self._restore_metric_state(eval_metric,
+                                               resume_state.metric_state)
+                    self.logger.info("resume: fast-forwarding %d batches "
+                                     "of epoch %d", resume_skip, epoch)
                 for data_batch in train_iter:
+                    tail_batches = None
+                    if resume_skip > 0:
+                        n = getattr(data_batch, "num_steps", 1)
+                        if n <= resume_skip:
+                            resume_skip -= n
+                            nbatch += n
+                            continue
+                        # checkpoint cut through a superbatch (k changed
+                        # between runs): train only the un-skipped tail,
+                        # per-step
+                        tail_batches = data_batch.unstack()[resume_skip:]
+                        nbatch += resume_skip
+                        resume_skip = 0
                     if monitor is not None:
                         monitor.tic()
                     # fast path: K fused steps in one donated lax.scan
                     # dispatch, metrics accumulated on device, read back once
-                    if (k > 1 and getattr(data_batch, "num_steps", 0) == k
+                    if (tail_batches is None and k > 1
+                            and getattr(data_batch, "num_steps", 0) == k
                             and fused_steps(data_batch, eval_metric)):
                         nbatch += data_batch.num_steps
+                        since_ckpt += data_batch.num_steps
                     else:
                         # per-step path: the general executor loop, also the
                         # epoch tail (num_steps < k) without a K'-recompile
-                        for batch in (data_batch.unstack()
-                                      if hasattr(data_batch, "unstack")
-                                      else [data_batch]):
+                        if tail_batches is None:
+                            tail_batches = (data_batch.unstack()
+                                            if hasattr(data_batch, "unstack")
+                                            else [data_batch])
+                        for batch in tail_batches:
                             nbatch += 1
+                            since_ckpt += 1
                             # fused single step (falls back to the executor
                             # path when the module configuration needs it —
                             # monitor, dist kvstore, grad_req, unfused
@@ -218,6 +290,13 @@ class BaseModule(object):
                             self.update_metric(eval_metric, batch.label)
                     if monitor is not None:
                         monitor.toc_print()
+                    if (ckpt_mgr is not None and checkpoint_every_n_batches
+                            and since_ckpt >= checkpoint_every_n_batches):
+                        ckpt_mgr.save(self, epoch, nbatch + 1,
+                                      metric=eval_metric)
+                        since_ckpt = 0
+                    self._check_worker_health(ckpt_mgr, eval_metric, epoch,
+                                              nbatch)
                     if batch_end_callback is not None:
                         batch_end_params = BatchEndParam(
                             epoch=epoch, nbatch=nbatch,
@@ -246,6 +325,10 @@ class BaseModule(object):
                     for name, val in res:
                         self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                          name, val)
+                if ckpt_mgr is not None:
+                    # epoch boundary checkpoint: cursor points at the clean
+                    # start of the next epoch
+                    ckpt_mgr.save(self, epoch + 1, 0)
                 if train_iter is train_data or epoch < num_epoch - 1:
                     train_iter.reset()
                 else:
@@ -260,6 +343,48 @@ class BaseModule(object):
                 # exception paths included: never leave a producer thread
                 # consuming the user's iterator (close() is idempotent)
                 train_iter.close()
+
+    # -- fault tolerance hooks (docs/robustness.md) ---------------------
+    def _apply_resume_state(self, st):
+        """Restore optimizer state, update clock and RNG stream from a
+        validated checkpoint (params/aux already rode ``init_params``).
+        Called by ``fit`` right after ``init_optimizer``."""
+        if st.opt_states_file and hasattr(self, "load_optimizer_states"):
+            self.load_optimizer_states(st.opt_states_file)
+        self._restore_trainer_clock(st.num_update)
+        st.restore_rng()
+
+    def _restore_trainer_clock(self, num_update):
+        """Hook: carry the optimizer update count across a resume so lr
+        schedules and per-step noise streams continue where the killed run
+        stopped. Subclasses with an optimizer override."""
+
+    @staticmethod
+    def _restore_metric_state(eval_metric, state):
+        """Replay a checkpointed metric's partial sums into a freshly reset
+        metric (scalar or per-output list state; composites skip)."""
+        if not state or not hasattr(eval_metric, "sum_metric"):
+            return
+        try:
+            s, n = state
+        except (TypeError, ValueError):
+            return
+        eval_metric.sum_metric = s
+        eval_metric.num_inst = n
+
+    def _check_worker_health(self, ckpt_mgr, eval_metric, epoch, nbatch):
+        """Dist kvstore degradation policy: feed ``num_dead_node`` into
+        warn -> emergency checkpoint -> ``WorkerLostError`` escalation
+        (KVStore.check_health throttles the underlying heartbeat scan).
+        No-op for local stores."""
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or "dist" not in getattr(kv, "type", ""):
+            return
+        on_degraded = None
+        if ckpt_mgr is not None:
+            def on_degraded():
+                ckpt_mgr.save(self, epoch, nbatch + 1, metric=eval_metric)
+        kv.check_health(on_degraded=on_degraded)
 
     # -- symbol / params accessors -------------------------------------
     @property
@@ -280,23 +405,14 @@ class BaseModule(object):
                          force_init=force_init)
 
     def save_params(self, fname):
+        from ..model import atomic_write_bytes, _param_save_bytes
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        atomic_write_bytes(fname, _param_save_bytes(arg_params, aux_params))
 
     def load_params(self, fname):
+        from ..model import _split_param_dict
         save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
+        arg_params, aux_params = _split_param_dict(save_dict, fname)
         self.set_params(arg_params, aux_params)
 
     # -- computation API (implemented by subclasses) --------------------

@@ -79,6 +79,9 @@ class Module(BaseModule):
         self._fused_params_stale = False
         self._fused_metrics_ok = False
         self._monitor_installed = False
+        # checkpoint resume: the update-count the fused step clock (and lr
+        # schedule) continues from (set via _restore_trainer_clock)
+        self._resume_step = 0
 
     # -- checkpointing (ref: module.py:97-156, :674-704) ----------------
     @staticmethod
@@ -93,7 +96,9 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        self._symbol.save("%s-symbol.json" % prefix)
+        from ..model import atomic_write_bytes
+        atomic_write_bytes("%s-symbol.json" % prefix,
+                           self._symbol.tojson().encode())
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
         logging.info("Saved checkpoint to \"%s\"", param_name)
@@ -103,21 +108,25 @@ class Module(BaseModule):
             logging.info("Saved optimizer state to \"%s\"", state_name)
 
     def save_optimizer_states(self, fname):
+        """Returns the serialized bytes so callers (CheckpointManager) can
+        checksum the INTENDED payload rather than re-read the file — a torn
+        write then fails manifest validation instead of sealing as valid."""
         assert self.optimizer_initialized
         self._sync_fused_opt_states()
         if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            return self._kvstore.save_optimizer_states(fname)
+        from ..model import atomic_write_bytes
+        data = self._updater.get_states()
+        atomic_write_bytes(fname, data)
+        return data
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
-            with open(fname, "rb") as fin:
-                self._updater.set_states(fin.read())
+            from ..model import apply_optimizer_states
+            apply_optimizer_states(self._updater.set_states, fname)
 
     # -- properties -----------------------------------------------------
     @property
@@ -351,6 +360,32 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    def _restore_trainer_clock(self, num_update):
+        """Resume hook: continue the optimizer's update clock (lr schedule,
+        per-index counts, fused step counter) from a checkpoint."""
+        n = int(num_update or 0)
+        self._resume_step = n
+
+        def wind(opt):
+            opt.num_update = n
+            opt.begin_num_update = n
+            opt._index_update_count = {}
+
+        if self._optimizer is not None:
+            wind(self._optimizer)
+        # the update_on_kvstore path updates through the kvstore updater's
+        # PICKLED optimizer copy (set_optimizer round-trip) — wind that
+        # clock too or its lr schedule restarts from 0 after resume
+        updater = self._updater
+        if self._update_on_kvstore and self._kvstore is not None:
+            updater = getattr(self._kvstore, "_updater", None)
+        if updater is not None and getattr(updater, "optimizer",
+                                           None) is not None:
+            wind(updater.optimizer)
+        if self._fused_state is not None:
+            import jax.numpy as jnp
+            self._fused_state["step"] = jnp.full((), n, jnp.int32)
+
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer
@@ -471,7 +506,9 @@ class Module(BaseModule):
             step = prev["step"]
         else:
             opt_state = self._fused_opt_state(params)
-            step = jnp.zeros((), jnp.int32)
+            # a resumed run continues the step clock (noise streams /
+            # schedules) where the killed run stopped, not at 0
+            step = jnp.full((), self._resume_step, jnp.int32)
         state = {"params": params, "aux": aux, "opt": opt_state,
                  "step": step}
         if self._fused.mesh is not None:

@@ -231,15 +231,31 @@ class MXRecordIO(object):
 
     def read(self):
         assert not self.writable
+        offset = self.handle.tell()
         head = self.handle.read(4)
         if len(head) < 4:
+            if head:
+                raise MXNetError(
+                    "truncated RecordIO file %r: %d stray byte(s) at "
+                    "offset %d" % (self.uri, len(head), offset))
             return None
         (magic,) = _KMAGIC_STRUCT.unpack(head)
         if magic != _MAGIC:
-            raise MXNetError("invalid RecordIO magic")
-        (lrec,) = _LREC_STRUCT.unpack(self.handle.read(4))
+            raise MXNetError("invalid RecordIO magic at offset %d in %r"
+                             % (offset, self.uri))
+        lrec_buf = self.handle.read(4)
+        if len(lrec_buf) < 4:
+            raise MXNetError("truncated RecordIO file %r: record header "
+                             "cut short at offset %d" % (self.uri, offset))
+        (lrec,) = _LREC_STRUCT.unpack(lrec_buf)
         _cflag, length = _decode_lrec(lrec)
         buf = self.handle.read(length)
+        if len(buf) < length:
+            # a short payload silently poisons everything downstream
+            # (unpack reads garbage labels); fail loudly instead
+            raise MXNetError(
+                "truncated record in %r at offset %d: expected %d payload "
+                "bytes, got %d" % (self.uri, offset, length, len(buf)))
         pad = (4 - (length % 4)) % 4
         if pad:
             self.handle.read(pad)
@@ -277,6 +293,9 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
+        if idx not in self.idx:
+            raise MXNetError("key %r not present in index %r (of %r)"
+                             % (idx, self.idx_path, self.uri))
         self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx):

@@ -1,0 +1,56 @@
+"""Subprocess target for the kill-and-resume integration test.
+
+Trains a small deterministic MLP with periodic checkpoints and
+``resume='auto'``; prints ``BATCH <n>`` after every dispatch so the parent
+test knows when to SIGKILL it mid-epoch, and writes the final params to an
+npz when (if) it survives to the end. Re-running the same command line after
+a kill must produce bitwise-identical final params to an uninterrupted run.
+
+Usage: python resume_worker.py <ckpt_prefix> <out_npz> <steps_per_dispatch>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def main(prefix, out_npz, k):
+    mx.random.seed(7)
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(256, 10)).astype(np.float32)
+    w = rng.normal(size=(10, 4)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)  # 16 batches/epoch
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+
+    def cb(param):
+        print("BATCH %d.%d" % (param.epoch, param.nbatch), flush=True)
+
+    from mxnet_tpu import lr_scheduler
+    mod.fit(train, num_epoch=2, steps_per_dispatch=k,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "lr_scheduler": lr_scheduler.FactorScheduler(
+                                  step=10, factor=0.5)},
+            batch_end_callback=cb,
+            checkpoint_prefix=prefix, checkpoint_every_n_batches=4,
+            resume="auto")
+    arg, aux = mod.get_params()
+    np.savez(out_npz, **{n: v.asnumpy() for n, v in arg.items()})
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2], int(sys.argv[3]))

@@ -1,0 +1,513 @@
+"""Fault-tolerant checkpointing and auto-resume (docs/robustness.md).
+
+Pins the recovery contract: atomic checksummed checkpoints with retention
+and a latest pointer, corrupt-checkpoint fallback, and ``fit(resume='auto')``
+reaching bitwise-identical params to an uninterrupted run — in-process for
+tier-1, and through a real SIGKILL of a subprocess in the slow-marked
+integration test. Satellite coverage: load_checkpoint key validation,
+optimizer-states error wrapping + round-trip, FeedForward save/load.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, nd, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.model import (CheckpointManager, load_checkpoint,
+                             save_checkpoint, atomic_write_bytes)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _mlp(num_hidden=16, num_classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _toy_data(n=128, dim=10, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, classes)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return X, y
+
+
+def _opt_params():
+    from mxnet_tpu import lr_scheduler
+    return {"learning_rate": 0.1, "momentum": 0.9,
+            "lr_scheduler": lr_scheduler.FactorScheduler(step=5,
+                                                         factor=0.5)}
+
+
+class _Interrupt(Exception):
+    pass
+
+
+def _run_fit(X, y, k, num_epoch=2, interrupt_after=None, prefix=None,
+             resume=None, every=4):
+    """One deterministic training run; returns final arg params as numpy.
+    ``interrupt_after`` simulates a kill after that many TOTAL batches."""
+    mx.random.seed(3)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    n_per_epoch = X.shape[0] // 16
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    cb = None
+    if interrupt_after is not None:
+        def cb(p):
+            if p.epoch * n_per_epoch + p.nbatch + 1 >= interrupt_after:
+                raise _Interrupt()
+    try:
+        mod.fit(train, num_epoch=num_epoch, optimizer_params=_opt_params(),
+                batch_end_callback=cb, steps_per_dispatch=k,
+                checkpoint_prefix=prefix,
+                checkpoint_every_n_batches=every if prefix else None,
+                resume=resume)
+    except _Interrupt:
+        pass
+    arg, _ = mod.get_params()
+    return {n: v.asnumpy() for n, v in arg.items()}
+
+
+# -- the core acceptance: kill mid-epoch, resume, bitwise-identical ---------
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_interrupted_resume_bitwise_identical(tmp_path, k):
+    X, y = _toy_data()
+    ref = _run_fit(X, y, k)
+    prefix = str(tmp_path / "ck")
+    _run_fit(X, y, k, interrupt_after=11, prefix=prefix)   # dies mid-epoch 2
+    got = _run_fit(X, y, k, prefix=prefix, resume="auto")
+    assert sorted(ref) == sorted(got)
+    for name in ref:
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
+
+
+def test_resume_after_k_change_trains_tail_per_step(tmp_path):
+    # checkpoint cut mid-superbatch: saved under k=1 at a non-multiple of
+    # the new k — resume with k=2 must still finish and converge sanely
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _run_fit(X, y, 1, interrupt_after=10, prefix=prefix, every=3)
+    got = _run_fit(X, y, 2, prefix=prefix, resume="auto", every=3)
+    assert all(np.isfinite(v).all() for v in got.values())
+
+
+def test_resume_auto_without_checkpoint_starts_fresh(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "never-written")
+    ref = _run_fit(X, y, 1)
+    got = _run_fit(X, y, 1, prefix=prefix, resume="auto")
+    for name in ref:
+        np.testing.assert_array_equal(ref[name], got[name])
+
+
+def test_resume_requires_prefix():
+    X, y = _toy_data(32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    with pytest.raises(MXNetError, match="checkpoint_prefix"):
+        mod.fit(train, num_epoch=1, resume="auto")
+
+
+# -- checkpoint manager mechanics -------------------------------------------
+
+def _trained_module(X, y, prefix=None, every=None):
+    mx.random.seed(0)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1,
+                                                  "momentum": 0.9},
+            checkpoint_prefix=prefix, checkpoint_every_n_batches=every)
+    return mod
+
+
+def test_manifest_records_cursor_clock_and_checksums(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _trained_module(X, y, prefix=prefix, every=3)
+    mgr = CheckpointManager(prefix)
+    st = mgr.load_latest()
+    assert st.epoch == 1 and st.batches_done == 0   # epoch-end checkpoint
+    assert st.num_update == 8                       # 8 batches trained
+    man = json.loads(open(mgr._file(st.tag, "manifest.json")).read())
+    assert set(man["files"]) == {"params", "states"}
+    for info in man["files"].values():
+        assert len(info["sha256"]) == 64 and info["size"] > 0
+    assert st.rng is not None
+    # latest pointer agrees
+    assert open(mgr.latest_path).read().strip() == st.tag
+
+
+def test_retention_prunes_oldest(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    mx.random.seed(0)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1},
+            checkpoint_prefix=prefix, checkpoint_every_n_batches=2,
+            checkpoint_keep=2)
+    mgr = CheckpointManager(prefix, keep=2)
+    tags = mgr.list_tags()
+    assert len(tags) == 2                 # 5 saves, 2 kept
+    # pruned checkpoints' files are gone from disk
+    data_files = [f for f in os.listdir(tmp_path)
+                  if f.endswith((".params", ".states"))]
+    assert len(data_files) == 4
+
+
+def test_corrupt_newest_falls_back_to_previous(tmp_path, caplog):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _trained_module(X, y, prefix=prefix, every=3)
+    mgr = CheckpointManager(prefix)
+    tags = mgr.list_tags()
+    newest = tags[-1]
+    # truncate the newest params file behind the manifest's back
+    params_f = mgr._file(newest, "params")
+    with open(params_f, "r+b") as f:
+        f.truncate(os.path.getsize(params_f) // 2)
+    import logging
+    with caplog.at_level(logging.WARNING):
+        st = mgr.load_latest()
+    assert st is not None and st.tag == tags[-2]
+    assert any("failed validation" in r.message for r in caplog.records)
+
+
+def test_injected_torn_write_detected_and_skipped(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    mx.random.seed(0)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    # write order per mid-epoch save: params, states, manifest, latest;
+    # first save also writes symbol.json 3rd => call 6 is the SECOND
+    # checkpoint's params write
+    faults.inject("checkpoint.write", nth=6, kind="truncate")
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1},
+            checkpoint_prefix=prefix, checkpoint_every_n_batches=2,
+            checkpoint_keep=10)
+    faults.clear()
+    mgr = CheckpointManager(prefix)
+    tags = mgr.list_tags()
+    torn = tags[1]
+    with pytest.raises(MXNetError, match="truncated|checksum"):
+        mgr.load(torn)
+    st = mgr.load_latest()                 # falls back over the torn one
+    assert st is not None and st.tag != torn
+
+
+def test_checkpoint_write_abort_preserves_previous(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _trained_module(X, y, prefix=prefix, every=4)
+    mgr = CheckpointManager(prefix)
+    before = mgr.load_latest()
+    mod2 = _trained_module(X, y)
+    faults.inject("checkpoint.write.mid", nth=1, kind="raise")
+    with pytest.raises(faults.InjectedFault):
+        mgr.save(mod2, 9, 0)
+    faults.clear()
+    st = mgr.load_latest()
+    assert st.tag == before.tag            # old generation intact
+
+
+# -- legacy checkpoint API satellites ---------------------------------------
+
+def test_load_checkpoint_rejects_malformed_keys(tmp_path):
+    prefix = str(tmp_path / "model")
+    save_checkpoint(prefix, 1, _mlp(), {"fc1_weight": nd.ones((2, 2))}, {})
+    # overwrite with a params file containing a bad key
+    bad = {"nonsense-key": nd.ones((1,))}
+    nd.save("%s-0001.params" % prefix, bad)
+    with pytest.raises(MXNetError) as ei:
+        load_checkpoint(prefix, 1)
+    assert "nonsense-key" in str(ei.value)
+    assert "%s-0001.params" % prefix in str(ei.value)
+
+
+def test_load_checkpoint_rejects_unknown_prefix(tmp_path):
+    prefix = str(tmp_path / "model")
+    save_checkpoint(prefix, 1, _mlp(), {"fc1_weight": nd.ones((2, 2))}, {})
+    nd.save("%s-0001.params" % prefix, {"grad:fc1_weight": nd.ones((2, 2))})
+    with pytest.raises(MXNetError, match="unknown prefix 'grad'"):
+        load_checkpoint(prefix, 1)
+
+
+def test_save_checkpoint_roundtrip_atomic(tmp_path):
+    prefix = str(tmp_path / "model")
+    arg = {"fc1_weight": nd.array(np.arange(6, dtype=np.float32)
+                                  .reshape(2, 3))}
+    aux = {"bn_moving_mean": nd.array(np.ones(3, np.float32))}
+    save_checkpoint(prefix, 7, _mlp(), arg, aux)
+    s, a, x = load_checkpoint(prefix, 7)
+    np.testing.assert_array_equal(a["fc1_weight"].asnumpy(),
+                                  arg["fc1_weight"].asnumpy())
+    np.testing.assert_array_equal(x["bn_moving_mean"].asnumpy(),
+                                  np.ones(3))
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+# -- optimizer states satellites --------------------------------------------
+
+def test_kvstore_optimizer_states_roundtrip_momentum(tmp_path):
+    kv = mx.kvstore.create("local")
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.1, momentum=0.9))
+    w = nd.array(np.ones((4,), np.float32))
+    kv.init(0, w)
+    kv.push(0, nd.array(np.full((4,), 0.5, np.float32)))
+    kv.pull(0, w)
+    mom_before = kv._updater.states[0].asnumpy()
+    assert np.any(mom_before != 0)
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+
+    kv2 = mx.kvstore.create("local")
+    kv2.set_optimizer(opt.create("sgd", learning_rate=0.1, momentum=0.9))
+    kv2.load_optimizer_states(fname)
+    np.testing.assert_array_equal(kv2._updater.states[0].asnumpy(),
+                                  mom_before)
+
+
+def test_kvstore_load_states_missing_file_actionable():
+    kv = mx.kvstore.create("local")
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.1))
+    with pytest.raises(MXNetError, match="save_optimizer_states"):
+        kv.load_optimizer_states("/nonexistent/opt.states")
+
+
+def test_kvstore_load_states_truncated_actionable(tmp_path):
+    kv = mx.kvstore.create("local")
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.1, momentum=0.9))
+    w = nd.array(np.ones((4,), np.float32))
+    kv.init(0, w)
+    kv.push(0, nd.array(np.ones((4,), np.float32)))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    with open(fname, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(fname) // 3))
+    with pytest.raises(MXNetError, match="corrupt or truncated"):
+        kv.load_optimizer_states(fname)
+
+
+def test_module_load_states_errors_actionable(tmp_path):
+    X, y = _toy_data(32)
+    mod = _trained_module(X, y)
+    with pytest.raises(MXNetError, match="save_optimizer_states"):
+        mod.load_optimizer_states(str(tmp_path / "missing.states"))
+    fname = str(tmp_path / "t.states")
+    mod.save_optimizer_states(fname)
+    with open(fname, "r+b") as f:
+        f.truncate(5)
+    with pytest.raises(MXNetError, match="corrupt or truncated"):
+        mod.load_optimizer_states(fname)
+
+
+# -- FeedForward satellites --------------------------------------------------
+
+def _bn_mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = sym.BatchNorm(data=net, name="bn1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_feedforward_save_load_epoch_none(tmp_path):
+    X, y = _toy_data(64)
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=2,
+                                 numpy_batch_size=16, learning_rate=0.1)
+    model.fit(X, y)
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)                     # epoch=None -> num_epoch
+    assert os.path.exists("%s-0002.params" % prefix)
+    loaded = mx.model.FeedForward.load(prefix, 2, ctx=mx.cpu())
+    assert loaded.begin_epoch == 2
+    for n, v in model.arg_params.items():
+        np.testing.assert_array_equal(v.asnumpy(),
+                                      loaded.arg_params[n].asnumpy(),
+                                      err_msg=n)
+
+
+def test_feedforward_save_load_with_aux_params(tmp_path):
+    X, y = _toy_data(64)
+    model = mx.model.FeedForward(_bn_mlp(), ctx=mx.cpu(), num_epoch=1,
+                                 numpy_batch_size=16, learning_rate=0.05)
+    model.fit(X, y)
+    assert model.aux_params, "BatchNorm should produce aux params"
+    prefix = str(tmp_path / "ffbn")
+    model.save(prefix, epoch=5)
+    loaded = mx.model.FeedForward.load(prefix, 5, ctx=mx.cpu())
+    assert sorted(loaded.aux_params) == sorted(model.aux_params)
+    for n, v in model.aux_params.items():
+        np.testing.assert_array_equal(v.asnumpy(),
+                                      loaded.aux_params[n].asnumpy(),
+                                      err_msg=n)
+    # and the loaded model predicts without re-fitting
+    pred = loaded.predict(X[:16])
+    assert pred.shape == (16, 4)
+
+
+def test_feedforward_save_epoch_none_without_num_epoch_asserts(tmp_path):
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu())
+    with pytest.raises(AssertionError):
+        model.save(str(tmp_path / "ff"))
+
+
+# -- the real thing: SIGKILL a training process and resume it ---------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("k", [1, 2])
+def test_sigkill_and_resume_bitwise_identical(tmp_path, k):
+    worker = os.path.join(os.path.dirname(__file__), "resume_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def launch(prefix, out):
+        return subprocess.Popen(
+            [sys.executable, worker, prefix, out, str(k)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+
+    # reference: uninterrupted run
+    ref_out = str(tmp_path / "ref.npz")
+    p = launch(str(tmp_path / "ref-ck"), ref_out)
+    assert p.wait(timeout=600) == 0, p.stdout.read()
+
+    # victim: SIGKILL once it is past mid-epoch-1 (batch cursor 1.x)
+    prefix = str(tmp_path / "ck")
+    out = str(tmp_path / "resumed.npz")
+    p = launch(prefix, out)
+    killed = False
+    deadline = time.monotonic() + 600
+    for line in p.stdout:
+        if line.startswith("BATCH 1.") and time.monotonic() < deadline:
+            os.kill(p.pid, signal.SIGKILL)
+            killed = True
+            break
+    p.wait(timeout=60)
+    assert killed, "worker finished before it could be killed"
+    assert not os.path.exists(out)
+
+    # resume: same command line, resume='auto' picks up the checkpoint
+    p = launch(prefix, out)
+    assert p.wait(timeout=600) == 0, p.stdout.read()
+
+    ref = np.load(ref_out)
+    got = np.load(out)
+    assert sorted(ref.files) == sorted(got.files)
+    for name in ref.files:
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
+
+
+def test_load_latest_prefers_newer_tag_over_stale_pointer(tmp_path):
+    # crash between the manifest write and the latest-pointer write: the
+    # newest on-disk checkpoint must win over the stale pointer
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _trained_module(X, y, prefix=prefix, every=3)
+    mgr = CheckpointManager(prefix)
+    tags = mgr.list_tags()
+    atomic_write_bytes(mgr.latest_path, tags[0].encode())  # stale pointer
+    st = mgr.load_latest()
+    assert st.tag == tags[-1]
+
+
+def test_torn_states_write_fails_validation_and_falls_back(tmp_path):
+    # torn .states publish: the manifest checksums the INTENDED payload, so
+    # load_latest must reject the checkpoint and fall back, not seal it
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    mx.random.seed(0)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    # per-save write order: params, states, ... => call 7 is the SECOND
+    # checkpoint's states write (first save also writes symbol.json 3rd)
+    faults.inject("checkpoint.write", nth=7, kind="truncate")
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1,
+                                                  "momentum": 0.9},
+            checkpoint_prefix=prefix, checkpoint_every_n_batches=2,
+            checkpoint_keep=10)
+    faults.clear()
+    mgr = CheckpointManager(prefix)
+    torn = mgr.list_tags()[1]
+    with pytest.raises(MXNetError, match="truncated|checksum"):
+        mgr.load(torn)
+    st = mgr.load_latest()
+    assert st is not None and st.tag != torn
+
+
+def test_checkpoint_prefix_with_glob_chars(tmp_path):
+    X, y = _toy_data()
+    d = tmp_path / "run[1]"
+    d.mkdir()
+    prefix = str(d / "ck")
+    _trained_module(X, y, prefix=prefix, every=4)
+    mgr = CheckpointManager(prefix)
+    assert mgr.list_tags(), "glob chars in prefix must not disable resume"
+    assert mgr.load_latest() is not None
+
+
+def test_feedforward_predict_missing_weight_raises(tmp_path):
+    X, y = _toy_data(64)
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=1,
+                                 numpy_batch_size=16, learning_rate=0.1)
+    model.fit(X, y)
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, epoch=1)
+    loaded = mx.model.FeedForward.load(prefix, 1, ctx=mx.cpu())
+    del loaded.arg_params["fc2_weight"]        # a REAL weight goes missing
+    with pytest.raises(MXNetError, match="fc2_weight"):
+        loaded.predict(X[:16])
+
+
+def test_feedforward_predict_missing_aux_raises(tmp_path):
+    X, y = _toy_data(64)
+    model = mx.model.FeedForward(_bn_mlp(), ctx=mx.cpu(), num_epoch=1,
+                                 numpy_batch_size=16, learning_rate=0.05)
+    model.fit(X, y)
+    prefix = str(tmp_path / "ffbn")
+    model.save(prefix, epoch=1)
+    loaded = mx.model.FeedForward.load(prefix, 1, ctx=mx.cpu())
+    loaded.aux_params = {}                 # BN statistics go missing
+    with pytest.raises(MXNetError, match="bn1_moving"):
+        loaded.predict(X[:16])
+
+
+def test_restore_trainer_clock_reaches_kvstore_updater():
+    # the update_on_kvstore path updates through the kvstore updater's
+    # pickled optimizer copy; resume must wind THAT clock too
+    from mxnet_tpu import optimizer as opt
+    X, y = _toy_data(32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    kv = mx.kvstore.create("local")
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.1))
+    mod._kvstore = kv
+    mod._update_on_kvstore = True
+    mod._optimizer = opt.create("sgd", learning_rate=0.1)
+    mod.optimizer_initialized = True
+    mod._restore_trainer_clock(42)
+    assert mod._optimizer.num_update == 42
+    assert kv._updater.optimizer.num_update == 42
+    assert kv._updater.optimizer.begin_num_update == 42

@@ -1,0 +1,471 @@
+"""Deterministic fault injection across the async training pipeline
+(docs/robustness.md). Every failure mode the dependency-engine design
+assumes — record reads, H2D copies, producer threads, checkpoint writes,
+kvstore push/pull — is fired at an exact call count and its recovery path
+asserted, with no sleeps or races.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, nd
+from mxnet_tpu import io as mxio
+from mxnet_tpu.base import MXNetError
+
+pytestmark = pytest.mark.faults
+
+FAST = mxio.RetryPolicy(max_retries=3, base_delay=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- registry semantics ------------------------------------------------------
+
+def test_fire_counts_and_nth_targeting():
+    assert faults.fire("t.site") is None
+    faults.inject("t.site", nth=2, kind="raise")
+    assert faults.fire("t.site") is None          # call 2 overall, nth is
+    with pytest.raises(faults.InjectedFault):     # relative to arm time
+        faults.fire("t.site")
+    assert faults.fire("t.site") is None          # times=1: one shot
+    assert faults.count("t.site") == 4
+
+
+def test_scoped_clears_on_exit():
+    with faults.scoped("t.scoped", nth=1, kind="transient"):
+        with pytest.raises(faults.InjectedTransientFault):
+            faults.fire("t.scoped")
+    assert faults.fire("t.scoped") is None
+    assert faults.count("t.scoped") == 1
+
+
+def test_action_kinds_pass_through():
+    faults.inject("t.act", nth=1, kind="truncate")
+    assert faults.fire("t.act") == "truncate"
+
+
+def test_env_spec_parsing(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULTS", "t.env@2=transient*2")
+    faults.clear()
+    faults._env_loaded = False
+    assert faults.fire("t.env") is None
+    for _ in range(2):
+        with pytest.raises(faults.InjectedTransientFault):
+            faults.fire("t.env")
+    assert faults.fire("t.env") is None
+
+
+def test_env_spec_malformed_raises(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULTS", "not-a-spec")
+    faults.clear()
+    faults._env_loaded = False
+    with pytest.raises(MXNetError, match="MXTPU_FAULTS"):
+        faults.fire("t.env2")
+
+
+# -- retry helper ------------------------------------------------------------
+
+def test_retry_call_transient_within_budget():
+    health = mxio.DataHealth()
+    faults.inject("t.retry", nth=1, kind="transient", times=2)
+
+    def op():
+        faults.fire("t.retry")
+        return 42
+
+    assert mxio.retry_call(op, "t.retry", FAST, health) == 42
+    assert health.report()["retries"] == 2
+
+
+def test_retry_call_budget_exhaustion_names_site_and_attempts():
+    health = mxio.DataHealth()
+    faults.inject("t.retry2", nth=1, kind="transient", times=99)
+
+    def op():
+        faults.fire("t.retry2")
+
+    with pytest.raises(MXNetError, match=r"t\.retry2: giving up after 4 "
+                                         r"attempts"):
+        mxio.retry_call(op, "t.retry2", FAST, health)
+    assert health.report()["failures"] == 1
+
+
+def test_retry_call_nontransient_propagates_immediately():
+    calls = []
+
+    def op():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        mxio.retry_call(op, "t.retry3", FAST)
+    assert len(calls) == 1
+
+
+def test_retry_policy_backoff_deterministic():
+    p = mxio.RetryPolicy(base_delay=0.01, max_delay=0.04, jitter=0.5)
+    d1 = [p.delay(a, "site") for a in (1, 2, 3, 4)]
+    d2 = [p.delay(a, "site") for a in (1, 2, 3, 4)]
+    assert d1 == d2                       # same run-to-run
+    assert d1[0] < d1[1] < d1[2]          # exponential
+    assert all(d <= 0.04 * 1.5 for d in d1)   # capped (+jitter)
+    assert p.delay(2, "other") != d1[1]   # de-synchronized across sites
+
+
+# -- superbatch pipeline -----------------------------------------------------
+
+def _arange_iter(n=16, batch=4):
+    X = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    y = np.zeros(n, np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch)
+
+
+def test_superbatch_transient_reads_are_invisible():
+    def pull_all():
+        it = _arange_iter().superbatch(2, retry_policy=FAST)
+        return np.concatenate([b.data[0].asnumpy() for b in it])
+
+    clean = pull_all()
+    faults.inject("io.batch_read", nth=2, kind="transient", times=2)
+    faulty = pull_all()
+    np.testing.assert_array_equal(clean, faulty)
+
+
+def test_superbatch_read_failures_beyond_budget_raise():
+    faults.inject("io.batch_read", nth=1, kind="transient", times=99)
+    it = _arange_iter().superbatch(2, retry_policy=FAST)
+    with pytest.raises(MXNetError, match=r"io\.batch_read.*attempts"):
+        for _ in it:
+            pass
+
+
+class _HostBatchIter(mx.io.DataIter):
+    """Host-numpy batches (the next_host/ImageIter shape): superbatch
+    stacking lands them through the ONE-H2D path where io.h2d fires."""
+
+    def __init__(self, n_batches=4, batch=4):
+        super().__init__(batch)
+        self.n_batches = n_batches
+        self.i = 0
+        self.provide_data = [mx.io.DataDesc("data", (batch, 2))]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (batch,))]
+
+    def reset(self):
+        self.i = 0
+
+    def next_host(self):
+        if self.i >= self.n_batches:
+            raise StopIteration
+        self.i += 1
+        return mx.io.DataBatch(
+            data=[np.full((self.batch_size, 2), self.i, np.float32)],
+            label=[np.zeros(self.batch_size, np.float32)], pad=0)
+
+
+def test_superbatch_h2d_transient_retried():
+    health = mxio.DataHealth()
+    faults.inject("io.h2d", nth=1, kind="transient")
+    it = _HostBatchIter().superbatch(2, retry_policy=FAST,
+                                     data_health=health)
+    batches = list(it)
+    assert len(batches) == 2
+    assert health.report()["retries"] >= 1
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy()[0],
+                                  np.full((4, 2), 1, np.float32))
+
+
+def test_superbatch_producer_death_detected_not_hung():
+    faults.inject("superbatch.producer", nth=2, kind="die")
+    it = _arange_iter().superbatch(2, queue_depth=1)
+    with pytest.raises(MXNetError, match=r"superbatch\.producer"):
+        for _ in it:
+            pass
+
+
+def test_data_health_mirrors_into_global_aggregate():
+    mxio.DATA_HEALTH.reset()
+    child = mxio.DataHealth(parent=mxio.DATA_HEALTH)
+    child.record_retry("s", "e")
+    child.record_skip("s", "e")
+    assert child.report()["retries"] == 1
+    assert mxio.DATA_HEALTH.report()["retries"] == 1
+    assert mxio.DATA_HEALTH.report()["skipped_records"] == 1
+    mxio.DATA_HEALTH.reset()
+
+
+# -- image pipeline ----------------------------------------------------------
+
+def _tiny_rec(tmp_path, n=8, corrupt=()):
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(7)
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        if i in corrupt:
+            payload = b"\xff\xd8not-actually-a-jpeg"
+        else:
+            arr = (rng.rand(16, 16, 3) * 255).astype(np.uint8)
+            b = _io.BytesIO()
+            Image.fromarray(arr).save(b, "JPEG")
+            payload = b.getvalue()
+        writer.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), payload))
+    writer.close()
+    return rec_path
+
+
+def test_image_iter_transient_read_retried_same_pixels(tmp_path):
+    rec = _tiny_rec(tmp_path)
+
+    def read_all():
+        it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                                path_imgrec=rec, retry_policy=FAST)
+        return np.concatenate([b.data[0].asnumpy() for b in it])
+
+    clean = read_all()
+    faults.inject("io.record_read", nth=3, kind="transient", times=3)
+    faulty = read_all()
+    np.testing.assert_array_equal(clean, faulty)
+
+
+def test_image_iter_read_failures_beyond_budget_raise(tmp_path):
+    rec = _tiny_rec(tmp_path)
+    faults.inject("io.record_read", nth=1, kind="transient", times=99)
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=rec, retry_policy=FAST)
+    with pytest.raises(MXNetError, match=r"io\.record_read.*4 attempts"):
+        it.next()
+
+
+def test_image_iter_skips_corrupt_with_counter(tmp_path):
+    rec = _tiny_rec(tmp_path, n=9, corrupt={2})
+    health = mxio.DataHealth()
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=rec, skip_corrupt=True,
+                            data_health=health)
+    batches = list(it)
+    assert len(batches) == 2              # 8 good records / batch 4
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert 2.0 not in labels              # the corrupt record is gone
+    assert health.report()["skipped_records"] == 1
+
+
+def test_image_iter_corrupt_raises_without_skip(tmp_path):
+    rec = _tiny_rec(tmp_path, n=4, corrupt={1})
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=rec)
+    with pytest.raises(mxio.CorruptRecordError, match="corrupt image"):
+        it.next()
+
+
+def test_recordio_truncated_payload_detected(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"x" * 64)
+    w.close()
+    with open(path, "r+b") as f:
+        f.truncate(32)                    # cut inside the payload
+    r = recordio.MXRecordIO(path, "r")
+    with pytest.raises(MXNetError, match="truncated record"):
+        r.read()
+
+
+# -- checkpoint writes -------------------------------------------------------
+
+def test_atomic_write_abort_leaves_live_file_untouched(tmp_path):
+    from mxnet_tpu.model import atomic_write_bytes
+    target = str(tmp_path / "f.bin")
+    atomic_write_bytes(target, b"generation-1")
+    faults.inject("checkpoint.write.mid", nth=1, kind="raise")
+    with pytest.raises(faults.InjectedFault):
+        atomic_write_bytes(target, b"generation-2-longer")
+    with open(target, "rb") as f:
+        assert f.read() == b"generation-1"     # old data intact
+    leftovers = [p for p in tmp_path.iterdir() if ".tmp" in p.name]
+    assert not leftovers                       # no orphaned temp files
+
+
+def test_atomic_write_truncate_kind_produces_torn_file(tmp_path):
+    from mxnet_tpu.model import atomic_write_bytes
+    target = str(tmp_path / "f.bin")
+    faults.inject("checkpoint.write", nth=1, kind="truncate")
+    atomic_write_bytes(target, b"0123456789")
+    with open(target, "rb") as f:
+        assert f.read() == b"01234"            # torn, for load-side tests
+
+
+# -- kvstore -----------------------------------------------------------------
+
+def _local_kv():
+    kv = mx.kvstore.create("local")
+    kv.set_fault_policy(retries=2, backoff=0.0)
+    kv.init(0, nd.array(np.ones(3, np.float32)))
+    return kv
+
+
+def test_kvstore_push_transient_retried_once_applied_once():
+    kv = _local_kv()
+    faults.inject("kvstore.push", nth=1, kind="transient")
+    kv.push(0, nd.array(np.full(3, 5.0, np.float32)))
+    out = nd.array(np.zeros(3, np.float32))
+    kv.pull(0, out)
+    # the retried push replaced the stored value exactly once
+    np.testing.assert_array_equal(out.asnumpy(), np.full(3, 5.0))
+
+
+def test_kvstore_push_budget_exhaustion():
+    kv = _local_kv()
+    faults.inject("kvstore.push", nth=1, kind="transient", times=99)
+    with pytest.raises(MXNetError, match=r"kvstore\.push failed after 3 "
+                                         r"attempts"):
+        kv.push(0, nd.array(np.ones(3, np.float32)))
+
+
+def test_kvstore_drop_kind_is_retried():
+    kv = _local_kv()
+    faults.inject("kvstore.pull", nth=1, kind="drop")
+    out = nd.array(np.zeros(3, np.float32))
+    kv.pull(0, out)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones(3))
+
+
+def test_kvstore_barrier_timeout_escalates_without_reentry():
+    # a STARTED barrier that times out must escalate immediately, never
+    # retry: the abandoned watchdog thread may still be participating in
+    # the collective, and re-entering it would corrupt the rendezvous
+    kv = mx.kvstore.create("local")
+    kv.set_fault_policy(timeout=0.05, retries=3, backoff=0.0)
+    held = {"v": True}
+    entries = []
+
+    def slow_barrier():
+        import time
+        entries.append(1)
+        t0 = time.monotonic()
+        while held["v"] and time.monotonic() - t0 < 5:
+            time.sleep(0.005)
+
+    kv._barrier = slow_barrier
+    try:
+        with pytest.raises(MXNetError, match=r"kvstore\.barrier timed out "
+                                             r"after it started"):
+            kv.barrier()
+        assert len(entries) == 1          # no second entry into the barrier
+    finally:
+        held["v"] = False
+
+
+def test_kvstore_degradation_warn_checkpoint_raise():
+    kv = mx.kvstore.create("local")
+    kv.set_fault_policy(health_interval=0.0)
+    faults.inject("kvstore.dead_node", nth=1, kind="dead:2", times=99)
+    checkpoints = []
+    assert kv.check_health(on_degraded=lambda: checkpoints.append(1),
+                           force=True) == 2          # strike 1: warn
+    assert kv.check_health(on_degraded=lambda: checkpoints.append(1),
+                           force=True) == 2          # strike 2: checkpoint
+    assert checkpoints == [1]
+    with pytest.raises(mx.kvstore.WorkerLostError):  # strike 3: raise
+        kv.check_health(force=True)
+
+
+def test_kvstore_recovery_resets_strikes():
+    kv = mx.kvstore.create("local")
+    kv.set_fault_policy(health_interval=0.0)
+    faults.inject("kvstore.dead_node", nth=1, kind="dead:1", times=2)
+    kv.check_health(force=True)
+    kv.check_health(force=True)
+    assert kv.check_health(force=True) == 0   # healthy scan resets
+    assert kv._dead_strikes == 0
+
+
+def test_heartbeat_startup_grace_not_dead_before_first_publish():
+    from mxnet_tpu.kvstore import _Heartbeat
+
+    class FakeClient(object):
+        def __init__(self, stamps):
+            self.stamps = stamps
+
+        def key_value_try_get(self, key):
+            if key not in self.stamps:
+                raise KeyError(key)
+            return self.stamps[key]
+
+    import time
+    hb = _Heartbeat.__new__(_Heartbeat)
+    hb.rank = 0
+    hb.interval = 2.0
+    hb.startup_grace = None
+    hb._started = time.time()
+    hb._seen = set()
+    hb._stop = None
+    client = FakeClient({})
+    hb._client = lambda: client
+    # peer 1 has never published and we just started: NOT dead (grace)
+    assert hb.dead_nodes(2, timeout_sec=60) == 0
+    # once a peer has been seen, silence means dead
+    client.stamps[_Heartbeat.KEY % 1] = repr(time.time())
+    assert hb.dead_nodes(2, timeout_sec=60) == 0
+    del client.stamps[_Heartbeat.KEY % 1]
+    assert hb.dead_nodes(2, timeout_sec=60) == 1
+    # a stale (old) beat also counts as dead
+    client.stamps[_Heartbeat.KEY % 1] = repr(time.time() - 120)
+    assert hb.dead_nodes(2, timeout_sec=60) == 1
+    # and a never-seen peer past the startup grace is dead too
+    hb2 = _Heartbeat.__new__(_Heartbeat)
+    hb2.rank = 0
+    hb2.interval = 2.0
+    hb2.startup_grace = 0.0
+    hb2._started = time.time() - 1
+    hb2._seen = set()
+    hb2._client = lambda: FakeClient({})
+    assert hb2.dead_nodes(2, timeout_sec=60) == 1
+
+
+def test_retry_call_permanent_oserror_not_retried():
+    calls = []
+
+    def op():
+        calls.append(1)
+        raise FileNotFoundError("/no/such/file")
+
+    with pytest.raises(FileNotFoundError):
+        mxio.retry_call(op, "t.perm", FAST)
+    assert len(calls) == 1                # no budget burned, real cause kept
+
+
+def test_image_iter_skips_record_level_corruption(tmp_path):
+    # damage the RECORD framing (not the JPEG): skip_corrupt must still skip
+    from mxnet_tpu import recordio
+    rec = _tiny_rec(tmp_path, n=8)
+    idx_path = str(tmp_path / "data.idx")
+    reader = recordio.MXIndexedRecordIO(idx_path, rec, "r")
+    off = reader.idx[3]
+    reader.close()
+    with open(rec, "r+b") as f:
+        f.seek(off)
+        f.write(b"\x00\x00\x00\x00")      # clobber the magic of record 3
+    health = mxio.DataHealth()
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=rec, skip_corrupt=True,
+                            data_health=health)
+    labels = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert 3.0 not in labels
+    assert health.report()["skipped_records"] == 1
+
+
+def test_retry_policy_jitter_decorrelated_across_workers(monkeypatch):
+    monkeypatch.setenv("MXTPU_RANK", "0")
+    p0 = mxio.RetryPolicy(base_delay=0.01)
+    monkeypatch.setenv("MXTPU_RANK", "1")
+    p1 = mxio.RetryPolicy(base_delay=0.01)
+    assert p0.delay(1, "io.record_read") != p1.delay(1, "io.record_read")

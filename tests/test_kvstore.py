@@ -80,3 +80,34 @@ def test_dist_async_rejected():
     import pytest
     with pytest.raises(mx.base.NotImplementedForTPU):
         kvs.create("dist_async")
+
+
+def test_fault_policy_env_defaults(monkeypatch):
+    """Timeout/retry/backoff knobs are env-seeded (docs/robustness.md) and
+    overridable per-store via set_fault_policy."""
+    monkeypatch.setenv("MXTPU_KV_TIMEOUT", "1.5")
+    monkeypatch.setenv("MXTPU_KV_RETRIES", "5")
+    monkeypatch.setenv("MXTPU_KV_BACKOFF", "0.01")
+    kv = kvs.create("local")
+    assert kv._timeout == 1.5
+    assert kv._retries == 5
+    assert kv._backoff == 0.01
+    kv.set_fault_policy(timeout=None, retries=1)
+    assert kv._timeout is None and kv._retries == 1
+
+
+def test_fault_policy_env_malformed(monkeypatch):
+    import pytest
+    monkeypatch.setenv("MXTPU_KV_TIMEOUT", "soon")
+    with pytest.raises(mx.base.MXNetError, match="MXTPU_KV_TIMEOUT"):
+        kvs.create("local")
+
+
+def test_check_health_throttled_by_interval():
+    kv = kvs.create("local")
+    kv.set_fault_policy(health_interval=3600.0)
+    assert kv.check_health(force=True) == 0
+    # a throttled scan does not even consult num_dead_node
+    kv.num_dead_node = lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("scan not throttled"))
+    assert kv.check_health() == 0
