@@ -6,6 +6,8 @@ See SURVEY.md at the repo root for the structural map of the reference
 """
 from .base import MXNetError, __version__
 from . import faults
+from . import guard
+from .guard import TrainingGuard, TrainingHealth, TrainingDivergedError
 from . import initialize as _initialize  # signal handlers (initialize.cc)
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_devices
 from . import base

@@ -70,6 +70,19 @@ def attr_float(v, default=None):
     return float(v)
 
 
+def env_float(name, default):
+    """Parse an env var as a float knob (the MXTPU_KV_* / MXTPU_GUARD_*
+    readers share this); unset or blank means ``default``."""
+    import os
+    v = os.environ.get(name)
+    if v is None or v.strip() == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise MXNetError("%s must be a number, got %r" % (name, v))
+
+
 def attr_str(v, default=None):
     if v is _NULL or v is None:
         return default

@@ -49,7 +49,10 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer(object):
-    """Log samples/sec every ``frequent`` batches (ref: callback.py Speedometer)."""
+    """Log samples/sec every ``frequent`` batches (ref: callback.py
+    Speedometer). A guarded run (docs/robustness.md "Numerical guardrails")
+    appends the ``TrainingHealth`` counters — skipped batches, rollbacks,
+    last grad-norm — so a limping run is diagnosable from the log alone."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
@@ -58,6 +61,25 @@ class Speedometer(object):
         self.tic = 0
         self.last_count = 0
         self._fired = 0
+
+    @staticmethod
+    def _health_suffix(param):
+        """THIS run's TrainingHealth counters when it is guarded, empty
+        otherwise — strictly per-run: the guard rides in through
+        ``param.locals`` (fit exposes its locals there), never the
+        process-global ``TRAINING_HEALTH`` mirror, whose aggregate would
+        leak one run's counters into another run's (or score()'s) lines."""
+        loc = getattr(param, "locals", None)
+        g = loc.get("guard") if isinstance(loc, dict) else None
+        if g is None:
+            return ""
+        h = g.health.report()
+        if not (h["skipped"] or h["rollbacks"] or h["divergences"]):
+            return ""
+        gn = ("%.4g" % h["last_grad_norm"]
+              if h["last_grad_norm"] is not None else "n/a")
+        return ("\tGuard: skipped=%d rollbacks=%d grad_norm=%s"
+                % (h["skipped"], h["rollbacks"], gn))
 
     def __call__(self, param):
         count = param.nbatch
@@ -72,17 +94,19 @@ class Speedometer(object):
             if count // self.frequent > self._fired // self.frequent:
                 speed = ((count - self._fired) * self.batch_size
                          / (time.time() - self.tic))
+                health = self._health_suffix(param)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     param.eval_metric.reset()
                     for name, value in name_value:
                         logging.info(
                             "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                            "\tTrain-%s=%f", param.epoch, count, speed, name,
-                            value)
+                            "\tTrain-%s=%f%s", param.epoch, count, speed,
+                            name, value, health)
                 else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
+                    logging.info(
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                        param.epoch, count, speed, health)
                 self._fired = count
                 self.tic = time.time()
         else:

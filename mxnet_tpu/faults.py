@@ -23,6 +23,13 @@ site                         where it fires
 ``kvstore.pull``             before a KVStore pull
 ``kvstore.barrier``          before a KVStore barrier
 ``kvstore.dead_node``        inside ``KVStore.check_health``
+``guard.grad_nan``           per train step in a GUARDED fused dispatch —
+                             poisons that step's gradients with NaN on
+                             device (fired via :func:`fire_flag`)
+``guard.loss_spike``         per guarded dispatch observation — inflates
+                             the loss the divergence watcher sees
+``guard.param_nan``          at checkpoint save — forces the manifest's
+                             known-good bit off (params "went non-finite")
 ===========================  ==============================================
 
 Rule kinds:
@@ -186,6 +193,26 @@ def fire(site):
         time.sleep(hit.delay)
         return "delay"
     return hit.kind
+
+
+def fire_flag(site):
+    """Hook for sites that interpret a fault as DATA POISON rather than a
+    control-flow exception: like :func:`fire` it counts the call and matches
+    rules, but it never raises or sleeps — it just returns True when any
+    armed rule (of any kind) covers this call. Used by the training guard
+    sites: ``guard.grad_nan`` poisons the compiled step's gradients,
+    ``guard.loss_spike`` inflates the observed loss, ``guard.param_nan``
+    forces the checkpoint's known-good bit off — so the plain
+    ``faults.inject(site, nth=N)`` default arms all of them.
+    """
+    with _lock:
+        _load_env_locked()
+        call_no = _counts.get(site, 0) + 1
+        _counts[site] = call_no
+        for rule in _rules.get(site, ()):
+            if rule.covers(call_no):
+                return True
+    return False
 
 
 class scoped(object):

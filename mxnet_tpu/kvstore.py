@@ -52,14 +52,7 @@ class WorkerLostError(MXNetError):
     run should checkpoint (already done at strike 2) and surface."""
 
 
-def _env_float(name, default):
-    v = os.environ.get(name)
-    if v is None or v == "":
-        return default
-    try:
-        return float(v)
-    except ValueError:
-        raise MXNetError("%s must be a number, got %r" % (name, v))
+from .base import env_float as _env_float
 
 
 def _run_with_timeout(fn, timeout, site):

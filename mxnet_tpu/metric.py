@@ -330,13 +330,43 @@ def supports_device_sums(metric):
     """True when ``metric`` can consume the device-side K-step accumulators
     (loss sum / top-1 correct / sample count) that ``TrainStep.run_steps``
     carries through its scan — i.e. when ``Module.fit(steps_per_dispatch=k)``
-    can keep metrics on device and read back once per dispatch."""
+    can keep metrics on device and read back once per dispatch.
+
+    A CrossEntropy with a NON-default eps is a near-miss, not a fallback:
+    it would silently report slightly different losses than the in-scan
+    accumulator, so it raises :class:`MXNetError` naming the metric and
+    eps instead of degrading to per-step dispatch."""
     if isinstance(metric, CompositeEvalMetric):
-        return bool(metric.metrics) and all(supports_device_sums(m)
-                                            for m in metric.metrics)
+        # the CrossEntropy eps rejection must be order-independent, and
+        # must fire ONLY when the composite would otherwise qualify: a
+        # sibling that plainly can't use device sums already forces the
+        # per-step fallback, where any eps works — raising there would
+        # demand a fix that cannot help
+        ok = bool(metric.metrics)
+        eps_error = None
+        for m in metric.metrics:
+            try:
+                if not supports_device_sums(m):
+                    ok = False
+            except MXNetError as e:
+                eps_error = e
+        if not ok:
+            return False
+        if eps_error is not None:
+            raise eps_error
+        return True
     # exact types: subclasses may redefine what update() accumulates
     if type(metric) is CrossEntropy:
-        return metric.eps == 1e-8  # the in-scan loss uses the default eps
+        if metric.eps != 1e-8:
+            # the in-scan loss hardcodes the default eps; silently falling
+            # back to per-step dispatch would bury the real conflict, so
+            # name the metric and the eps and say what to change
+            raise MXNetError(
+                "metric %r (CrossEntropy) has eps=%g but the device-sum "
+                "dispatch path computes its in-scan loss with eps=1e-8 — "
+                "construct CrossEntropy(eps=1e-8) or train with "
+                "steps_per_dispatch=1" % (metric.name, metric.eps))
+        return True
     return type(metric) is Accuracy and metric.axis == 1
 
 

@@ -93,7 +93,11 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 # is whole before trusting it, and fall back to the previous one when not.
 # ---------------------------------------------------------------------------
 
-CKPT_VERSION = 1
+# version 2 adds the manifest's ``known_good`` bit (finite params verified
+# at save time); loaders still read version-1 manifests but resume/rollback
+# refuses them — a checkpoint that cannot PROVE its params were finite is
+# exactly the corpse auto-resume must not revive (docs/robustness.md)
+CKPT_VERSION = 2
 
 
 def _fsync_dir(dirname):
@@ -240,9 +244,9 @@ def load_checkpoint(prefix, epoch):
 class CheckpointState(object):
     """A validated checkpoint loaded by :class:`CheckpointManager`."""
 
-    __slots__ = ("tag", "epoch", "batches_done", "num_update", "arg_params",
-                 "aux_params", "opt_states_file", "rng", "metric_state",
-                 "manifest")
+    __slots__ = ("tag", "epoch", "batches_done", "num_update", "fused_step",
+                 "arg_params", "aux_params", "opt_states_file", "rng",
+                 "metric_state", "manifest", "known_good")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -339,14 +343,32 @@ class CheckpointManager(object):
                 atomic_write_bytes(sym_f, module.symbol.tojson().encode())
 
         opt = getattr(module, "_optimizer", None)
+        # the device step counter can TRAIL num_update when the guard
+        # skipped non-finite steps (a skip is a full no-op, the host lr
+        # clock still advances); record it so resume/rollback restores the
+        # exact noise/Adam-t clock instead of re-deriving it from num_update
+        fused_step = getattr(module, "_fused_step_count", None)
+        fused_step = fused_step() if callable(fused_step) else None
+        known_good = self._params_finite(arg_params, aux_params)
+        from . import faults as _faults
+        if _faults.fire_flag("guard.param_nan"):
+            known_good = False
+        if not known_good:
+            self.logger.warning(
+                "checkpoint %s: params are NOT all finite — saving anyway "
+                "(post-mortem value) but not marking it known-good; "
+                "resume/rollback will skip it", tag)
         manifest = {
             "version": CKPT_VERSION,
             "tag": tag,
             "epoch": int(epoch),
             "batches_done": int(batches_done),
             "num_update": int(getattr(opt, "num_update", 0) or 0),
+            "known_good": bool(known_good),
             "files": files,
         }
+        if fused_step is not None:
+            manifest["fused_step"] = int(fused_step)
         if self.save_rng:
             import jax
             from . import random as _random
@@ -364,6 +386,19 @@ class CheckpointManager(object):
         self.logger.info("Saved checkpoint %s (epoch %d, %d batches done)",
                          tag, epoch, batches_done)
         return tag
+
+    @staticmethod
+    def _params_finite(arg_params, aux_params):
+        """Known-good verification: every float param/aux array is fully
+        finite. Int/bool arrays are trivially finite and skipped; the scan
+        costs one host pass over data the save already hashed."""
+        for tree in (arg_params, aux_params):
+            for v in (tree or {}).values():
+                a = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+                if (np.issubdtype(a.dtype, np.floating)
+                        and not np.isfinite(a).all()):
+                    return False
+        return True
 
     @staticmethod
     def _metric_state(metric):
@@ -436,15 +471,22 @@ class CheckpointManager(object):
             tag=tag, epoch=int(manifest["epoch"]),
             batches_done=int(manifest["batches_done"]),
             num_update=int(manifest.get("num_update", 0)),
+            fused_step=manifest.get("fused_step"),
             arg_params=arg_params, aux_params=aux_params,
             opt_states_file=paths.get("states"),
             rng=manifest.get("rng"), metric_state=manifest.get("metric"),
-            manifest=manifest)
+            manifest=manifest, known_good=manifest.get("known_good"))
 
-    def load_latest(self):
+    def load_latest(self, require_known_good=True):
         """Newest VALID checkpoint, or None. A corrupt/truncated newest
         checkpoint is skipped with a warning and the previous valid one is
-        returned — the auto-resume entry point.
+        returned — the auto-resume (and divergence-rollback) entry point.
+
+        ``require_known_good`` (default): checkpoints whose manifest lacks
+        ``known_good: true`` — params were non-finite at save time, or the
+        manifest predates the known-good bit — are skipped with a warning.
+        Resuming one would faithfully revive a numerically dead run; pass
+        ``require_known_good=False`` only for forensics.
 
         Tags are tried newest-first by cursor order; the ``latest`` pointer
         is only a fallback (a crash between the manifest write and the
@@ -460,28 +502,56 @@ class CheckpointManager(object):
             pass
         for tag in candidates:
             try:
-                return self.load(tag)
+                st = self.load(tag)
             except MXNetError as e:
                 self.logger.warning(
                     "checkpoint %s failed validation (%s); falling back to "
                     "the previous checkpoint", tag, e)
+                continue
+            if require_known_good and st.known_good is not True:
+                self.logger.warning(
+                    "checkpoint %s is not marked known-good (non-finite "
+                    "params at save time, or a pre-guard manifest); "
+                    "skipping it for resume/rollback", tag)
+                continue
+            return st
         return None
 
     # -- retention -----------------------------------------------------
+    def _read_manifest(self, tag):
+        try:
+            with open(self._file(tag, "manifest.json"), "rb") as f:
+                return json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return None
+
     def _prune(self):
         tags = self.list_tags()
-        for tag in tags[:-self.keep]:
-            man_f = self._file(tag, "manifest.json")
-            base_dir = os.path.dirname(os.path.abspath(self.prefix))
-            try:
-                with open(man_f, "rb") as f:
-                    manifest = json.loads(f.read().decode())
+        old = tags[:-self.keep]
+        if not old:
+            return
+        # age-only retention would be fatal after a numerical death: a run
+        # whose params went non-finite keeps writing post-mortem
+        # (not-known-good) checkpoints, pushing the last RESUMABLE state
+        # out of the window — so the newest known-good tag is never pruned
+        newest_good = None
+        for tag in reversed(tags):
+            man = self._read_manifest(tag)
+            if man is not None and man.get("known_good") is True:
+                newest_good = tag
+                break
+        base_dir = os.path.dirname(os.path.abspath(self.prefix))
+        for tag in old:
+            if tag == newest_good:
+                continue
+            manifest = self._read_manifest(tag)
+            if manifest is not None:
                 victims = [os.path.join(base_dir, i["name"])
                            for i in manifest.get("files", {}).values()]
-            except (OSError, ValueError):
+            else:
                 victims = [self._file(tag, "params"),
                            self._file(tag, "states")]
-            for path in victims + [man_f]:
+            for path in victims + [self._file(tag, "manifest.json")]:
                 try:
                     os.unlink(path)
                 except OSError:

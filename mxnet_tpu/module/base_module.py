@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import namedtuple
 
@@ -123,7 +124,7 @@ class BaseModule(object):
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, steps_per_dispatch=None, resume=None,
             checkpoint_prefix=None, checkpoint_every_n_batches=None,
-            checkpoint_keep=3):
+            checkpoint_keep=3, guard=None):
         """The training loop (ref: base_module.py:368-519).
 
         ``steps_per_dispatch=k`` (default: ``engine.bulk_size()``, normally
@@ -145,6 +146,19 @@ class BaseModule(object):
         the train iterator past the already-trained batches, so a killed
         run re-launched with the same script continues bit-for-bit. The
         last ``checkpoint_keep`` checkpoints are retained.
+
+        Numerical guardrails (docs/robustness.md "Numerical guardrails"):
+        ``guard=True`` (or a configured
+        :class:`~mxnet_tpu.guard.TrainingGuard`; ``MXTPU_GUARD=1`` turns it
+        on by default) makes non-finite steps device-side no-ops counted in
+        ``guard.health``, watches a rolling loss window, and on divergence
+        rolls back to the newest *known-good* checkpoint with the lr
+        reduced by ``guard.lr_factor`` — raising
+        :class:`~mxnet_tpu.guard.TrainingDivergedError` once
+        ``guard.max_rollbacks`` is exhausted (or immediately when no
+        ``checkpoint_prefix``/known-good checkpoint exists to roll back
+        to). Requires the fused fast path; ineligible configurations warn
+        and train unguarded.
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
@@ -195,6 +209,30 @@ class BaseModule(object):
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        # numerical guardrails (docs/robustness.md "Numerical guardrails")
+        from ..guard import TrainingGuard, _DivergenceRollback
+        if guard is None and os.environ.get("MXTPU_GUARD", "") \
+                .strip().lower() not in ("", "0", "false", "off", "no"):
+            guard = True
+        if guard in (None, False):
+            guard = None
+        else:
+            if not isinstance(guard, TrainingGuard):
+                guard = TrainingGuard(logger=self.logger)
+            can = getattr(self, "_can_guard", None)
+            ok, why = (can() if can is not None
+                       else (False, "this module has no fused guard "
+                             "support"))
+            if not ok:
+                self.logger.warning(
+                    "guard: training-health guardrails unavailable (%s); "
+                    "training UNGUARDED", why)
+                guard = None
+            elif ckpt_mgr is None:
+                self.logger.warning(
+                    "guard: no checkpoint_prefix — divergence cannot roll "
+                    "back and will raise TrainingDivergedError")
+
         fused_step = getattr(self, "_try_fused_fit_step", None)
         fused_steps = getattr(self, "_try_fused_fit_steps", None)
         k = (steps_per_dispatch if steps_per_dispatch is not None
@@ -206,9 +244,6 @@ class BaseModule(object):
                 reason = "a monitor needs per-step executor access"
             elif fused_steps is None:
                 reason = "this module has no fused multi-step path"
-            elif not _metric.supports_device_sums(eval_metric):
-                reason = ("metric %r cannot consume device-side K-step sums"
-                          % eval_metric.name)
             elif not hasattr(train_data, "superbatch"):
                 reason = "train_data is not a DataIter (no superbatch mode)"
             else:
@@ -221,6 +256,15 @@ class BaseModule(object):
                     ok, why = can()
                     if not ok:
                         reason = why
+            if reason is None and not _metric.supports_device_sums(
+                    eval_metric):
+                # checked LAST: supports_device_sums raises for near-miss
+                # metrics (CrossEntropy eps), and that rejection must only
+                # fire when the run would otherwise take the device-sum
+                # path — an already-ineligible config falls back per-step,
+                # where the host metric honors any eps
+                reason = ("metric %r cannot consume device-side K-step sums"
+                          % eval_metric.name)
             if reason is not None:
                 self.logger.warning(
                     "steps_per_dispatch=%d unavailable (%s); training "
@@ -229,7 +273,8 @@ class BaseModule(object):
         train_iter = train_data.superbatch(k) if k > 1 else train_data
 
         try:
-            for epoch in range(begin_epoch, num_epoch):
+            epoch = begin_epoch
+            while epoch < num_epoch:
                 tic = time.time()
                 eval_metric.reset()
                 nbatch = -1
@@ -238,77 +283,120 @@ class BaseModule(object):
                 if (resume_state is not None
                         and epoch == resume_state.epoch
                         and resume_state.batches_done > 0):
-                    # mid-epoch resume: replay the metric's partial sums and
-                    # fast-forward past the already-trained batches (the
-                    # iterator is consumed but nothing is computed)
+                    # mid-epoch resume (or divergence rollback): replay the
+                    # metric's partial sums and fast-forward past the
+                    # already-trained batches (the iterator is consumed but
+                    # nothing is computed)
                     resume_skip = resume_state.batches_done
                     self._restore_metric_state(eval_metric,
                                                resume_state.metric_state)
                     self.logger.info("resume: fast-forwarding %d batches "
                                      "of epoch %d", resume_skip, epoch)
-                for data_batch in train_iter:
-                    tail_batches = None
-                    if resume_skip > 0:
-                        n = getattr(data_batch, "num_steps", 1)
-                        if n <= resume_skip:
-                            resume_skip -= n
-                            nbatch += n
-                            continue
-                        # checkpoint cut through a superbatch (k changed
-                        # between runs): train only the un-skipped tail,
-                        # per-step
-                        tail_batches = data_batch.unstack()[resume_skip:]
-                        nbatch += resume_skip
-                        resume_skip = 0
-                    if monitor is not None:
-                        monitor.tic()
-                    # fast path: K fused steps in one donated lax.scan
-                    # dispatch, metrics accumulated on device, read back once
-                    if (tail_batches is None and k > 1
-                            and getattr(data_batch, "num_steps", 0) == k
-                            and fused_steps(data_batch, eval_metric)):
-                        nbatch += data_batch.num_steps
-                        since_ckpt += data_batch.num_steps
-                    else:
-                        # per-step path: the general executor loop, also the
-                        # epoch tail (num_steps < k) without a K'-recompile
-                        if tail_batches is None:
-                            tail_batches = (data_batch.unstack()
-                                            if hasattr(data_batch, "unstack")
-                                            else [data_batch])
-                        for batch in tail_batches:
-                            nbatch += 1
-                            since_ckpt += 1
-                            # fused single step (falls back to the executor
-                            # path when the module configuration needs it —
-                            # monitor, dist kvstore, grad_req, unfused
-                            # optimizer, bucketing/shared modules)
-                            if monitor is not None or fused_step is None \
-                                    or not fused_step(batch):
-                                self.forward_backward(batch)
-                                self.update()
-                            self.update_metric(eval_metric, batch.label)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if (ckpt_mgr is not None and checkpoint_every_n_batches
-                            and since_ckpt >= checkpoint_every_n_batches):
-                        ckpt_mgr.save(self, epoch, nbatch + 1,
-                                      metric=eval_metric)
-                        since_ckpt = 0
-                    self._check_worker_health(ckpt_mgr, eval_metric, epoch,
-                                              nbatch)
-                    if batch_end_callback is not None:
-                        batch_end_params = BatchEndParam(
-                            epoch=epoch, nbatch=nbatch,
-                            eval_metric=eval_metric, locals=locals())
-                        for callback in _as_list(batch_end_callback):
-                            callback(batch_end_params)
+                try:
+                    for data_batch in train_iter:
+                        tail_batches = None
+                        if resume_skip > 0:
+                            n = getattr(data_batch, "num_steps", 1)
+                            if n <= resume_skip:
+                                resume_skip -= n
+                                nbatch += n
+                                continue
+                            # checkpoint cut through a superbatch (k changed
+                            # between runs): train only the un-skipped tail,
+                            # per-step
+                            tail_batches = data_batch.unstack()[resume_skip:]
+                            nbatch += resume_skip
+                            resume_skip = 0
+                        if monitor is not None:
+                            monitor.tic()
+                        # fast path: K fused steps in one donated lax.scan
+                        # dispatch, metrics accumulated on device, read back
+                        # once
+                        if (tail_batches is None and k > 1
+                                and getattr(data_batch, "num_steps", 0) == k
+                                and fused_steps(data_batch, eval_metric,
+                                                guard)):
+                            nbatch += data_batch.num_steps
+                            since_ckpt += data_batch.num_steps
+                        else:
+                            # per-step path: the general executor loop, also
+                            # the epoch tail (num_steps < k) without a
+                            # K'-recompile
+                            if tail_batches is None:
+                                tail_batches = (
+                                    data_batch.unstack()
+                                    if hasattr(data_batch, "unstack")
+                                    else [data_batch])
+                            for batch in tail_batches:
+                                nbatch += 1
+                                since_ckpt += 1
+                                if guard is not None:
+                                    guard.last_step_skipped = False
+                                # fused single step (falls back to the
+                                # executor path when the module configuration
+                                # needs it — monitor, dist kvstore, grad_req,
+                                # unfused optimizer, bucketing/shared
+                                # modules)
+                                if monitor is not None or fused_step is None \
+                                        or not fused_step(batch, guard):
+                                    self.forward_backward(batch)
+                                    self.update()
+                                # a device-side skipped (non-finite) step
+                                # contributes nothing to the metric
+                                if guard is None \
+                                        or not guard.last_step_skipped:
+                                    self.update_metric(eval_metric,
+                                                       batch.label)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        if guard is not None and guard.diverged:
+                            # unwind to the rollback handler BEFORE the
+                            # checkpoint block: a diverged state must never
+                            # be sealed into a checkpoint
+                            raise _DivergenceRollback()
+                        if (ckpt_mgr is not None
+                                and checkpoint_every_n_batches
+                                and since_ckpt >= checkpoint_every_n_batches
+                                and (guard is None
+                                     or guard.ok_to_checkpoint())):
+                            # a mid-spike state is suspect: deferring the
+                            # save keeps the newest known-good checkpoint
+                            # PRE-spike, so a rollback escapes the
+                            # divergence instead of re-entering it
+                            ckpt_mgr.save(self, epoch, nbatch + 1,
+                                          metric=eval_metric)
+                            since_ckpt = 0
+                        self._check_worker_health(ckpt_mgr, eval_metric,
+                                                  epoch, nbatch)
+                        if batch_end_callback is not None:
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
+                except _DivergenceRollback:
+                    # divergence: restore the newest known-good checkpoint,
+                    # rewind the trainer clock, reduce lr, and re-enter the
+                    # epoch loop at the restored cursor (the iterator is
+                    # reset and re-fast-forwarded like a resume)
+                    resume_state = self._guard_rollback(guard, ckpt_mgr)
+                    epoch = resume_state.epoch
+                    train_iter.reset()
+                    continue
 
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
                 toc = time.time()
                 self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
                                  (toc - tic))
+                if guard is not None:
+                    h = guard.health.report()
+                    if h["skipped"] or h["rollbacks"]:
+                        self.logger.info(
+                            "Epoch[%d] TrainingHealth: skipped=%d "
+                            "rollbacks=%d divergences=%d last_grad_norm=%s",
+                            epoch, h["skipped"], h["rollbacks"],
+                            h["divergences"], h["last_grad_norm"])
 
                 arg_params, aux_params = self.get_params()
                 self.set_params(arg_params, aux_params)
@@ -325,9 +413,11 @@ class BaseModule(object):
                     for name, val in res:
                         self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                          name, val)
-                if ckpt_mgr is not None:
+                if ckpt_mgr is not None and (guard is None
+                                             or guard.ok_to_checkpoint()):
                     # epoch boundary checkpoint: cursor points at the clean
-                    # start of the next epoch
+                    # start of the next epoch (deferred while the loss
+                    # watcher is mid-spike, same as cadence saves)
                     ckpt_mgr.save(self, epoch + 1, 0)
                 if train_iter is train_data or epoch < num_epoch - 1:
                     train_iter.reset()
@@ -338,6 +428,7 @@ class BaseModule(object):
                     # consumes) and hand the user back a reset base iterator
                     train_iter.close()
                     train_data.reset()
+                epoch += 1
         finally:
             if train_iter is not train_data:
                 # exception paths included: never leave a producer thread
@@ -345,19 +436,72 @@ class BaseModule(object):
                 train_iter.close()
 
     # -- fault tolerance hooks (docs/robustness.md) ---------------------
+    def _guard_rollback(self, guard, ckpt_mgr):
+        """Divergence recovery (docs/robustness.md "Numerical guardrails"):
+        restore the newest known-good checkpoint, rewind the trainer clock
+        and RNG stream, reduce the lr by ``guard.lr_factor``, and hand the
+        restored cursor back to ``fit``'s epoch loop (which resets and
+        re-fast-forwards the iterator). Raises
+        :class:`~mxnet_tpu.guard.TrainingDivergedError` when the rollback
+        budget is exhausted or there is nothing safe to roll back to."""
+        from ..guard import TrainingDivergedError
+        if guard.health.rollbacks >= guard.max_rollbacks:
+            raise TrainingDivergedError(
+                "training diverged again after %d rollback(s) "
+                "(max_rollbacks=%d): %s"
+                % (guard.health.rollbacks, guard.max_rollbacks,
+                   guard.diverged_reason), health=guard.health)
+        if ckpt_mgr is None:
+            raise TrainingDivergedError(
+                "training diverged (%s) and fit() has no checkpoint_prefix "
+                "to roll back to — configure checkpoints or lower the lr"
+                % (guard.diverged_reason,), health=guard.health)
+        st = ckpt_mgr.load_latest()
+        if st is None:
+            raise TrainingDivergedError(
+                "training diverged (%s) and no known-good checkpoint "
+                "exists under %r" % (guard.diverged_reason,
+                                     ckpt_mgr.prefix), health=guard.health)
+        self.logger.warning(
+            "TrainingGuard: rolling back to known-good checkpoint %s "
+            "(epoch %d, %d batches done), reducing lr by x%g",
+            st.tag, st.epoch, st.batches_done, guard.lr_factor)
+        self.init_params(initializer=None, arg_params=st.arg_params,
+                         aux_params=st.aux_params, allow_missing=False,
+                         force_init=True)
+        # the diverged fused state must NOT survive (its optimizer state is
+        # poisoned); drop it BEFORE restoring the checkpointed one
+        self._drop_fused_state()
+        self._apply_resume_state(st)
+        self._scale_lr(guard.lr_factor)
+        guard.note_rollback(st.tag)
+        return st
+
+    def _drop_fused_state(self):
+        """Hook: discard (not flush) any fused device state so the next
+        dispatch reseeds from the just-restored params. Subclasses with a
+        fused path override."""
+
+    def _scale_lr(self, factor):
+        """Hook: reduce the learning rate everywhere the next step reads it
+        (rollback policy). Subclasses with an optimizer override."""
+
     def _apply_resume_state(self, st):
         """Restore optimizer state, update clock and RNG stream from a
         validated checkpoint (params/aux already rode ``init_params``).
         Called by ``fit`` right after ``init_optimizer``."""
         if st.opt_states_file and hasattr(self, "load_optimizer_states"):
             self.load_optimizer_states(st.opt_states_file)
-        self._restore_trainer_clock(st.num_update)
+        self._restore_trainer_clock(st.num_update,
+                                    getattr(st, "fused_step", None))
         st.restore_rng()
 
-    def _restore_trainer_clock(self, num_update):
+    def _restore_trainer_clock(self, num_update, fused_step=None):
         """Hook: carry the optimizer update count across a resume so lr
         schedules and per-step noise streams continue where the killed run
-        stopped. Subclasses with an optimizer override."""
+        stopped. ``fused_step`` is the device step counter, which trails
+        ``num_update`` by the number of guard-skipped steps (a skip is a
+        full no-op). Subclasses with an optimizer override."""
 
     @staticmethod
     def _restore_metric_state(eval_metric, state):

@@ -360,11 +360,60 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
-    def _restore_trainer_clock(self, num_update):
+    def _resolve_updater(self):
+        """The updater whose optimizer copy actually applies updates: the
+        kvstore's pickled updater under update_on_kvstore, else the local
+        one (shared by the rollback lr-reduction, the resume clock-wind and
+        fused-state seeding — one routing rule, three consumers)."""
+        if self._update_on_kvstore and self._kvstore is not None:
+            return getattr(self._kvstore, "_updater", None)
+        return self._updater
+
+    def _drop_fused_state(self):
+        """Divergence-rollback hook: discard the fused state tree WITHOUT
+        flushing it (it holds the diverged/poisoned params). The next fused
+        dispatch reseeds from the executor arrays + updater states the
+        rollback just restored; the TrainStep and its jit caches survive, so
+        a rollback never recompiles."""
+        self._fused_state = None
+        self._fused_outputs = None
+        self._fused_dirty = False
+        self._fused_params_stale = False
+
+    def _scale_lr(self, factor):
+        """Divergence-rollback hook: reduce the learning rate by ``factor``
+        everywhere the next step might read it — the optimizer, its
+        scheduler's base_lr, and the kvstore updater's pickled optimizer
+        copy (the same set _restore_trainer_clock winds)."""
+        def scale(opt_):
+            opt_.lr *= factor
+            if opt_.lr_scheduler is not None:
+                opt_.lr_scheduler.base_lr *= factor
+
+        if self._optimizer is not None:
+            scale(self._optimizer)
+        upd_opt = getattr(self._resolve_updater(), "optimizer", None)
+        if upd_opt is not None and upd_opt is not self._optimizer:
+            scale(upd_opt)
+
+    def _fused_step_count(self):
+        """The fused device step counter, for checkpoint manifests: trails
+        ``num_update`` by the number of guard-skipped steps, and is the
+        clock the dropout/SGLD noise streams and Adam's t actually follow.
+        None when no fused state is live."""
+        if self._fused_state is None:
+            return None
+        import numpy as np
+        return int(np.asarray(self._fused_state["step"]))
+
+    def _restore_trainer_clock(self, num_update, fused_step=None):
         """Resume hook: continue the optimizer's update clock (lr schedule,
-        per-index counts, fused step counter) from a checkpoint."""
+        per-index counts) from ``num_update`` and the fused step counter —
+        the noise/Adam-t clock — from ``fused_step`` (they differ by the
+        number of guard-skipped steps; pre-guard checkpoints carry only
+        ``num_update``)."""
         n = int(num_update or 0)
-        self._resume_step = n
+        self._resume_step = n if fused_step is None else int(fused_step)
 
         def wind(opt):
             opt.num_update = n
@@ -376,15 +425,14 @@ class Module(BaseModule):
         # the update_on_kvstore path updates through the kvstore updater's
         # PICKLED optimizer copy (set_optimizer round-trip) — wind that
         # clock too or its lr schedule restarts from 0 after resume
-        updater = self._updater
-        if self._update_on_kvstore and self._kvstore is not None:
-            updater = getattr(self._kvstore, "_updater", None)
+        updater = self._resolve_updater()
         if updater is not None and getattr(updater, "optimizer",
                                            None) is not None:
             wind(updater.optimizer)
         if self._fused_state is not None:
             import jax.numpy as jnp
-            self._fused_state["step"] = jnp.full((), n, jnp.int32)
+            self._fused_state["step"] = jnp.full((), self._resume_step,
+                                                 jnp.int32)
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
@@ -481,6 +529,13 @@ class Module(BaseModule):
                     "output, rank-1 label) head")
         return True, None
 
+    def _can_guard(self):
+        """fit()'s precheck for ``guard=``: the TrainingGuard's device
+        sentinels (and its in-graph loss observation) need the fused step
+        and a single classification head — the same eligibility set as
+        dispatch bulking."""
+        return self._can_bulk_dispatch()
+
     def _jnp_copy(self, x):
         import jax.numpy as jnp
         if not getattr(x, "is_fully_addressable", True):
@@ -518,10 +573,7 @@ class Module(BaseModule):
     def _fused_opt_state(self, params):
         """Optimizer state for the fused tree, seeded from preloaded updater
         states when present (load_optimizer_states round-trip)."""
-        updater = self._updater
-        if self._update_on_kvstore and self._kvstore is not None:
-            updater = getattr(self._kvstore, "_updater", None)
-        states = dict(getattr(updater, "states", None) or {})
+        states = dict(getattr(self._resolve_updater(), "states", None) or {})
         idx_of = {n: i for i, n in enumerate(self._exec_group.param_names)}
 
         def to_jnp(x):
@@ -542,9 +594,15 @@ class Module(BaseModule):
                 out[n] = self._optimizer.create_fused_state(v)
         return out
 
-    def _try_fused_fit_step(self, data_batch):
+    def _try_fused_fit_step(self, data_batch, guard=None):
         """fit()'s fast path: one donated jit for fwd+bwd+update. Returns
-        False when the configuration needs the general executor path."""
+        False when the configuration needs the general executor path.
+
+        With a :class:`~mxnet_tpu.guard.TrainingGuard`, the guarded step
+        runs instead: device sentinels make a non-finite step a no-op, the
+        sentinel packet feeds ``guard.on_dispatch`` and
+        ``guard.last_step_skipped`` tells fit to keep the skipped batch out
+        of the host-side metric."""
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return False
@@ -552,7 +610,13 @@ class Module(BaseModule):
             if not self._fused_eligible():
                 return False
             self._build_fused()
-        if self._fused_params_stale:
+        if self._fused_state is None:
+            # dropped by a divergence rollback: reseed from the restored
+            # executor params + updater states (NOT prev — the diverged
+            # optimizer state must not survive the rollback)
+            self._fused_state = self._seed_fused_state()
+            self._fused_params_stale = False
+        elif self._fused_params_stale:
             self._fused_state = self._seed_fused_state(prev=self._fused_state)
             self._fused_params_stale = False
         eg = self._exec_group
@@ -571,6 +635,22 @@ class Module(BaseModule):
                 {k: _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
                  for k, v in batch.items()})
         from ..ndarray import NDArray
+        if guard is not None:
+            guard.last_step_skipped = False
+            self._fused_state, outs, packed = self._fused.step(
+                self._fused_state, batch, guard=True)
+            self._fused_outputs = [NDArray(local_view(o)) for o in outs]
+            self._fused_dirty = True
+            self._params_dirty = True
+            # the per-step path reads outputs for the metric anyway, so the
+            # sentinel readback costs no extra sync point
+            import numpy as _np
+            sent = _np.asarray(packed)
+            guard.on_dispatch(loss_sum=float(sent[0]), nsamp=float(sent[2]),
+                              skipped=float(sent[3]),
+                              grad_norm=float(sent[4]), nsteps=1)
+            guard.last_step_skipped = bool(sent[3] > 0)
+            return True
         self._fused_state, outs = self._fused.step(self._fused_state, batch)
         # per-worker view of batch-sharded outputs (each worker's metric
         # covers its own shard, matching reference per-worker eval)
@@ -579,12 +659,18 @@ class Module(BaseModule):
         self._params_dirty = True
         return True
 
-    def _try_fused_fit_steps(self, super_batch, eval_metric):
+    def _try_fused_fit_steps(self, super_batch, eval_metric, guard=None):
         """fit()'s K-step fast path: one donated ``lax.scan`` dispatch over a
         stacked superbatch (``TrainStep.run_steps``), with loss/top-1/count
         accumulated on device and folded into ``eval_metric`` via ONE host
         readback. Returns False when the configuration needs the general
-        per-step path (which ``fit`` then takes for this superbatch)."""
+        per-step path (which ``fit`` then takes for this superbatch).
+
+        With a :class:`~mxnet_tpu.guard.TrainingGuard` the guarded scan runs:
+        its sentinels (skip count, last grad norm) ride back in the SAME
+        packed readback as the metric sums — skipped steps are already
+        excluded from the metric denominators on device — and feed
+        ``guard.on_dispatch``."""
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return False
@@ -599,7 +685,12 @@ class Module(BaseModule):
             return False
         if not getattr(self, "_fused_metrics_ok", False):
             return False  # multi-head / non-classification: per-step metrics
-        if self._fused_params_stale:
+        if self._fused_state is None:
+            # dropped by a divergence rollback: reseed from the restored
+            # executor params + updater states
+            self._fused_state = self._seed_fused_state()
+            self._fused_params_stale = False
+        elif self._fused_params_stale:
             self._fused_state = self._seed_fused_state(prev=self._fused_state)
             self._fused_params_stale = False
         eg = self._exec_group
@@ -610,10 +701,16 @@ class Module(BaseModule):
             for name, value in zip(eg.label_names, super_batch.label):
                 batch[name] = value
         batch = self._fused.shard_superbatch(batch)
-        self._fused_state, sums = self._fused.run_steps(self._fused_state,
-                                                        batch)
+        self._fused_state, sums = self._fused.run_steps(
+            self._fused_state, batch, guard=guard is not None)
         from .. import metric as _metric
         _metric.update_from_device_sums(eval_metric, sums)
+        if guard is not None:
+            guard.on_dispatch(loss_sum=sums.loss_sum,
+                              nsamp=sums.num_samples,
+                              skipped=sums.skipped,
+                              grad_norm=sums.last_grad_norm,
+                              nsteps=super_batch.num_steps)
         self._fused_outputs = None  # outputs stay on device, un-materialized
         self._fused_dirty = True
         self._params_dirty = True
@@ -638,9 +735,7 @@ class Module(BaseModule):
         so save_optimizer_states round-trips."""
         if self._fused_state is None:
             return
-        updater = self._updater
-        if self._update_on_kvstore and self._kvstore is not None:
-            updater = getattr(self._kvstore, "_updater", None)
+        updater = self._resolve_updater()
         if updater is None:
             return
         from ..ndarray import NDArray

@@ -34,7 +34,8 @@ def register(klass):
 
 class Optimizer(object):
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
-                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 clip_gradient=None, clip_global_norm=None,
+                 learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0):
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
@@ -48,6 +49,11 @@ class Optimizer(object):
         self.num_update = begin_num_update
         self._index_update_count = {}
         self.clip_gradient = clip_gradient
+        # whole-gradient norm clip, applied by the FUSED train step across
+        # all parameters at once (train_step._make_step_fn) — the per-index
+        # imperative Updater cannot see every gradient in one call, so that
+        # path raises and points at clip_by_global_norm instead
+        self.clip_global_norm = clip_global_norm
         if param_idx2name is None:
             param_idx2name = {}
         self.idx2name = dict(param_idx2name)
@@ -160,6 +166,36 @@ class Optimizer(object):
 # create() factory (ref: mx.optimizer.create)
 def create(name, **kwargs):
     return Optimizer.create_optimizer(name, **kwargs)
+
+
+# -- global-norm clipping ----------------------------------------------------
+# The fused step applies Optimizer.clip_global_norm in-graph over ALL
+# parameter gradients at once (the sentinel grad-norm reduction doubles as
+# the clip's norm). These helpers are the imperative-side equivalent for
+# Updater users who collect their gradients first (and the reference the
+# fused path is parity-tested against).
+
+def global_norm(arrays):
+    """sqrt(sum of squared L2 norms) over a list of NDArray/array grads,
+    accumulated in float32."""
+    total = 0.0
+    for a in arrays:
+        v = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+        total += float(np.sum(np.square(v.astype(np.float32))))
+    return float(np.sqrt(total))
+
+
+def clip_by_global_norm(arrays, max_norm):
+    """Scale every array IN PLACE by ``min(1, max_norm / global_norm)``
+    (the standard Pascanu-style rescale). Returns the pre-clip global norm.
+    Matches the fused path's ``clip_global_norm`` bit-for-bit over the same
+    gradients (modulo f32 accumulation order)."""
+    norm = global_norm(arrays)
+    scale = min(1.0, float(max_norm) / max(norm, 1e-12))
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return norm
 
 
 @register
@@ -552,6 +588,13 @@ class Updater(object):
         self.states = {}
 
     def __call__(self, index, grad, weight):
+        if getattr(self.optimizer, "clip_global_norm", None):
+            raise MXNetError(
+                "clip_global_norm is applied by the fused train step, which "
+                "sees every gradient at once; the per-index imperative "
+                "updater cannot. Use clip_gradient (elementwise), or call "
+                "optimizer.clip_by_global_norm(grads, max_norm) over the "
+                "full gradient list before updating.")
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])

@@ -42,12 +42,19 @@ class StepMetrics(object):
     on device by ``TrainStep.run_steps``; the first property access performs
     the ONE host readback for the whole dispatch (and doubles as the sync
     point per-step training got from reading outputs every batch).
+
+    A GUARDED dispatch (``run_steps(..., guard=True)``) extends the packed
+    array to ``[..., skipped, last_grad_norm]`` — the training-health
+    sentinels ride back with the metric sums in the same single readback,
+    and skipped (non-finite) steps are already excluded from the
+    loss/correct/sample accumulators.
     """
 
-    __slots__ = ("device", "_host")
+    __slots__ = ("device", "guarded", "_host")
 
-    def __init__(self, device_array):
+    def __init__(self, device_array, guarded=False):
         self.device = device_array
+        self.guarded = guarded
         self._host = None
 
     def _vals(self):
@@ -79,9 +86,47 @@ class StepMetrics(object):
         n = self.num_samples
         return self.loss_sum / n if n else float("nan")
 
+    @property
+    def skipped(self):
+        """Count of device-side no-op (non-finite) steps in the dispatch;
+        0 for an unguarded dispatch."""
+        return int(self._vals()[3]) if self.guarded else 0
+
+    @property
+    def last_grad_norm(self):
+        """Global gradient norm of the dispatch's LAST step (guarded only;
+        NaN/Inf when that step was the poisoned one — informative)."""
+        return float(self._vals()[4]) if self.guarded else None
+
     def __repr__(self):
-        return ("StepMetrics(loss_sum=%.6g, top1_correct=%g, num_samples=%d)"
-                % (self.loss_sum, self.top1_correct, self.num_samples))
+        s = ("StepMetrics(loss_sum=%.6g, top1_correct=%g, num_samples=%d"
+             % (self.loss_sum, self.top1_correct, self.num_samples))
+        if self.guarded:
+            s += ", skipped=%d, last_grad_norm=%g" % (self.skipped,
+                                                      self.last_grad_norm)
+        return s + ")"
+
+
+def _metric_step_sums(outs, batch, label_names, zero):
+    """One step's device metric sums (CE loss, top-1 correct) over every
+    (rank-2 output, rank-1 label) pair, positionally. ONE definition shared
+    by the unguarded scan, the guarded scan and the guarded single step —
+    they are parity-tested against each other and against host
+    metric.CrossEntropy (eps 1e-8) / metric.Accuracy (argmax axis=1), so
+    the accumulation must never drift between paths."""
+    loss = zero
+    correct = zero
+    for o, lname in zip(outs, label_names):
+        lbl = batch.get(lname)
+        if (lbl is not None and getattr(o, "ndim", 0) == 2
+                and lbl.ndim == 1 and o.shape[0] == lbl.shape[0]):
+            li = lbl.astype(jnp.int32)
+            p = o[jnp.arange(o.shape[0]), li].astype(jnp.float32)
+            loss = loss + jnp.sum(-jnp.log(p + 1e-8))
+            correct = correct + jnp.sum(
+                (jnp.argmax(o, axis=1).astype(jnp.int32) == li)
+                .astype(jnp.float32))
+    return loss, correct
 
 
 class TrainStep(object):
@@ -173,6 +218,10 @@ class TrainStep(object):
             self._run = self._wrap_remat(self._run)
         self._jit = {}  # keyed by batch size (rescale_grad depends on it)
         self._jit_scan = {}  # keyed by (batch_size, k) — see run_steps
+        # guarded variants live in SEPARATE caches: enabling the guard must
+        # never retrace (or change the jaxpr of) the unguarded fast path
+        self._jit_g = {}
+        self._jit_scan_g = {}
         self._base_key = None  # drawn lazily from the global seeded stream
 
     # ------------------------------------------------------------------
@@ -318,10 +367,27 @@ class TrainStep(object):
             for k, v in batch.items()}
 
     # ------------------------------------------------------------------
-    def _make_step_fn(self, batch_size):
+    def _make_step_fn(self, batch_size, guard=False):
         """The fused fwd+bwd+update body, shared verbatim by the single-step
         jit (``step``) and the K-step ``lax.scan`` dispatch (``run_steps``)
-        so both paths compute identical numbers."""
+        so both paths compute identical numbers.
+
+        ``guard=True`` (docs/robustness.md "Numerical guardrails") adds
+        on-device training-health sentinels: a global gradient norm and an
+        all-finite flag over loss+grads (``jnp.isfinite`` reductions), and
+        makes the update GUARDED — when the flag is false every
+        param/opt/aux/step write ``jnp.where``-selects the old value, so the
+        poisoned step is a device-side no-op (no ``lax.cond`` host
+        round-trip). The guarded step_fn takes an extra traced ``poison``
+        scalar (0.0 normally; NaN when the ``guard.grad_nan`` fault site
+        fires) and returns ``(new_state, outs, (ok, grad_norm))``. With
+        ``guard=False`` the trace is byte-for-byte the unguarded body — no
+        sentinel ops, no retrace, jaxpr unchanged.
+
+        An optimizer ``clip_global_norm`` is applied here across ALL
+        parameter gradients at once (after rescale, before the per-optimizer
+        elementwise ``clip_gradient``), reusing the same norm reduction as
+        the sentinel."""
         run = self._run
         optzr = self._opt
         param_names = list(self.param_names)
@@ -335,8 +401,9 @@ class TrainStep(object):
         lr_mult = {n: optzr.lr_mult.get(n, 1.0) for n in updated}
         wd_mult = {n: optzr.wd_mult.get(n, 1.0) for n in updated}
         wd = optzr.wd
+        clip_norm = getattr(optzr, "clip_global_norm", None)
 
-        def step_fn(state, batch, key, lr_base):
+        def step_fn(state, batch, key, lr_base, poison=None):
             params, aux, opt = state["params"], state["aux"], state["opt"]
             # fold the state's OWN step counter into the key (traced, so no
             # host sync): restoring a checkpointed state reproduces the
@@ -362,23 +429,62 @@ class TrainStep(object):
             (grads,) = vjp_fn((cots, cots_aux))
 
             t = state["step"].astype(jnp.float32) + 1.0
+            gs = {n: grads[n].astype(params[n].dtype) * rescale
+                  for n in updated}
+            if poison is not None:
+                # guard.grad_nan fault site: poison is 0.0 on clean steps
+                # (identity) and NaN on the injected one — always threaded
+                # through the guarded trace so faulted and unfaulted guarded
+                # runs share ONE compiled program
+                gs = {n: g + poison.astype(g.dtype) for n, g in gs.items()}
+            gnorm = None
+            if guard or clip_norm is not None:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in gs.values()))
+            if clip_norm is not None:
+                scale = jnp.minimum(
+                    jnp.float32(1.0),
+                    jnp.float32(clip_norm) / jnp.maximum(gnorm, 1e-12))
+                gs = {n: g * scale.astype(g.dtype) for n, g in gs.items()}
+            ok = None
+            if guard:
+                # all-finite over loss+grads: outputs feed the in-scan loss,
+                # and any non-finite forward poisons the grads anyway
+                flags = [jnp.all(jnp.isfinite(g)) for g in gs.values()]
+                flags += [jnp.all(jnp.isfinite(o)) for o in outs]
+                ok = flags[0]
+                for fl in flags[1:]:
+                    ok = jnp.logical_and(ok, fl)
             new_params = dict(params)
             new_opt = {}
             for i, n in enumerate(updated):
                 w = params[n]
-                g = grads[n].astype(w.dtype) * rescale
+                g = gs[n]
                 subkey = (jax.random.fold_in(key, _OPT_KEY_OFFSET + i)
                           if needs_key else None)
                 new_w, new_s = optzr.fused_update(
                     n, w, g, opt[n], lr_base * lr_mult[n], wd * wd_mult[n],
                     t, key=subkey)
+                if guard:
+                    new_w = jnp.where(ok, new_w, w)
+                    new_s = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(ok, a, b), new_s, opt[n])
                 new_params[n] = new_w
                 new_opt[n] = new_s
             new_aux = dict(aux)
             for k, v in aux_up.items():
-                new_aux[k] = v.astype(aux[k].dtype)
+                nv = v.astype(aux[k].dtype)
+                if guard:
+                    nv = jnp.where(ok, nv, aux[k])
+                new_aux[k] = nv
+            # a skipped step is a FULL no-op: the step counter (and with it
+            # the dropout/SGLD noise stream) does not advance either
+            step_inc = ok.astype(jnp.int32) if guard else 1
             new_state = {"params": new_params, "aux": new_aux,
-                         "opt": new_opt, "step": state["step"] + 1}
+                         "opt": new_opt, "step": state["step"] + step_inc}
+            if guard:
+                return new_state, outs, (ok, gnorm)
             return new_state, outs
 
         return step_fn
@@ -386,7 +492,31 @@ class TrainStep(object):
     def _build(self, batch_size):
         return jax.jit(self._make_step_fn(batch_size), donate_argnums=(0,))
 
-    def _build_scan(self, batch_size, k):
+    def _build_guard_step(self, batch_size):
+        """Guarded single-step jit: the fused body plus device sentinels,
+        returning ``(new_state, outs, packed)`` where ``packed`` is the same
+        ``[loss, correct, nsamp, skipped, grad_norm]`` layout the guarded
+        scan accumulates (zeros for a skipped step, so metric consumers
+        exclude it without a second readback)."""
+        step_fn = self._make_step_fn(batch_size, guard=True)
+        label_names = list(self.label_names)
+
+        def fn(state, batch, key, lr, poison):
+            new_st, outs, (ok, gnorm) = step_fn(state, batch, key, lr,
+                                                poison)
+            zero = jnp.zeros((), jnp.float32)
+            loss, correct = _metric_step_sums(outs, batch, label_names,
+                                              zero)
+            okf = ok.astype(jnp.float32)
+            packed = jnp.stack([
+                jnp.where(ok, loss, zero), jnp.where(ok, correct, zero),
+                okf * jnp.float32(batch_size), 1.0 - okf,
+                gnorm.astype(jnp.float32)])
+            return new_st, outs, packed
+
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def _build_scan(self, batch_size, k, guard=False):
         """K steps in ONE compiled dispatch: lax.scan of the fused step body
         over a stacked (k, batch, ...) superbatch, state donated across the
         whole scan. This is the reference engine's bulking — whole graph
@@ -398,31 +528,54 @@ class TrainStep(object):
         per K steps. Accumulation pairs each rank-2 output with its label by
         position, matching metric.CrossEntropy (eps 1e-8) / metric.Accuracy
         (argmax axis=1) bit-for-bit over the same outputs.
+
+        ``guard=True`` threads the training-health sentinels through the
+        scan: a per-step NaN poison vector rides in next to ``lrs``, skipped
+        (non-finite) steps are excluded from every metric accumulator, and
+        the packed result grows to ``[loss, correct, nsamp, skipped,
+        last_grad_norm]`` — sentinels ride back with the metric sums in the
+        SAME single readback. The ``guard=False`` trace is unchanged.
         """
-        step_fn = self._make_step_fn(batch_size)
+        step_fn = self._make_step_fn(batch_size, guard=guard)
         label_names = list(self.label_names)
 
-        def scan_fn(state, superbatch, key, lrs):
+        def scan_fn(state, superbatch, key, lrs, poisons=None):
             zero = jnp.zeros((), jnp.float32)
 
             def body(carry, xs):
-                st, (loss, correct, nsamp) = carry
-                batch, lr = xs
-                new_st, outs = step_fn(st, batch, key, lr)
-                for o, lname in zip(outs, label_names):
-                    lbl = batch.get(lname)
-                    if (lbl is not None and getattr(o, "ndim", 0) == 2
-                            and lbl.ndim == 1
-                            and o.shape[0] == lbl.shape[0]):
-                        li = lbl.astype(jnp.int32)
-                        p = o[jnp.arange(o.shape[0]), li].astype(jnp.float32)
-                        loss = loss + jnp.sum(-jnp.log(p + 1e-8))
-                        correct = correct + jnp.sum(
-                            (jnp.argmax(o, axis=1).astype(jnp.int32) == li)
-                            .astype(jnp.float32))
+                if guard:
+                    st, (loss, correct, nsamp, skipped, gnorm) = carry
+                    batch, lr, poison = xs
+                    new_st, outs, (ok, g_norm) = step_fn(st, batch, key, lr,
+                                                         poison)
+                else:
+                    st, (loss, correct, nsamp) = carry
+                    batch, lr = xs
+                    new_st, outs = step_fn(st, batch, key, lr)
+                step_loss, step_correct = _metric_step_sums(
+                    outs, batch, label_names, zero)
+                if guard:
+                    # skipped steps drop out of every accumulator: the
+                    # metric denominators never see the poisoned batch
+                    loss = loss + jnp.where(ok, step_loss, zero)
+                    correct = correct + jnp.where(ok, step_correct, zero)
+                    nsamp = nsamp + jnp.where(ok, jnp.float32(batch_size),
+                                              zero)
+                    skipped = skipped + jnp.where(ok, zero, jnp.float32(1))
+                    return (new_st, (loss, correct, nsamp, skipped,
+                                     g_norm.astype(jnp.float32))), None
+                loss = loss + step_loss
+                correct = correct + step_correct
                 nsamp = nsamp + jnp.float32(batch_size)
                 return (new_st, (loss, correct, nsamp)), None
 
+            if guard:
+                (state, (loss, correct, nsamp, skipped, gnorm)), _ = \
+                    jax.lax.scan(body,
+                                 (state, (zero, zero, zero, zero, zero)),
+                                 (superbatch, lrs, poisons))
+                return state, jnp.stack([loss, correct, nsamp, skipped,
+                                         gnorm])
             (state, (loss, correct, nsamp)), _ = jax.lax.scan(
                 body, (state, (zero, zero, zero)), (superbatch, lrs))
             # one packed array => one host transfer for all K-step metrics
@@ -447,15 +600,36 @@ class TrainStep(object):
             return self._opt.lr_scheduler(self._opt.num_update)
         return self._opt.lr
 
-    def step(self, state, batch):
-        """One fused train step. ``batch``: dict name -> array."""
+    def _poison_scalars(self, k):
+        """Host-side ``guard.grad_nan`` firing, one shot per TRAINING step:
+        a (k,) float32 of 0.0 (clean) / NaN (poisoned) that rides into the
+        guarded trace (docs/robustness.md "Numerical guardrails")."""
+        from . import faults as _faults
+        return np.asarray(
+            [float("nan") if _faults.fire_flag("guard.grad_nan") else 0.0
+             for _ in range(k)], np.float32)
+
+    def step(self, state, batch, guard=False):
+        """One fused train step. ``batch``: dict name -> array.
+
+        ``guard=True`` runs the guarded body (non-finite steps become
+        device-side no-ops) and returns ``(new_state, outputs, packed)``
+        where ``packed`` is the ``[loss, correct, nsamp, skipped,
+        grad_norm]`` sentinel array (see :class:`StepMetrics`)."""
         bs = next(iter(batch.values())).shape[0]
+        if guard:
+            if bs not in self._jit_g:
+                self._jit_g[bs] = self._build_guard_step(bs)
+            return self._jit_g[bs](
+                state, batch, self._dispatch_key(),
+                jnp.asarray(self._next_lr(), jnp.float32),
+                jnp.asarray(self._poison_scalars(1)[0]))
         if bs not in self._jit:
             self._jit[bs] = self._build(bs)
         return self._jit[bs](state, batch, self._dispatch_key(),
                              jnp.asarray(self._next_lr(), jnp.float32))
 
-    def run_steps(self, state, superbatch, k=None):
+    def run_steps(self, state, superbatch, k=None, guard=False):
         """Run K fused train steps in ONE compiled dispatch.
 
         ``superbatch``: dict name -> stacked array of shape (k, batch, ...)
@@ -469,6 +643,12 @@ class TrainStep(object):
         :class:`StepMetrics` holding the device-resident K-step accumulators
         (loss sum, top-1 correct count, sample count) — reading any of its
         properties performs the single host readback for the dispatch.
+
+        ``guard=True`` compiles the GUARDED scan (separate jit cache; the
+        unguarded program is untouched): non-finite steps become device-side
+        no-ops, are excluded from the metric accumulators, and the returned
+        :class:`StepMetrics` additionally carries ``skipped`` and
+        ``last_grad_norm`` in the same single readback.
         """
         vals = list(superbatch.values())
         if not vals:
@@ -484,10 +664,16 @@ class TrainStep(object):
                              % {n: tuple(v.shape)
                                 for n, v in superbatch.items()})
         bs = vals[0].shape[1]
-        if (bs, k) not in self._jit_scan:
-            self._jit_scan[(bs, k)] = self._build_scan(bs, k)
+        cache = self._jit_scan_g if guard else self._jit_scan
+        if (bs, k) not in cache:
+            cache[(bs, k)] = self._build_scan(bs, k, guard=guard)
         lrs = jnp.asarray([self._next_lr() for _ in range(k)], jnp.float32)
-        new_state, packed = self._jit_scan[(bs, k)](
+        if guard:
+            new_state, packed = cache[(bs, k)](
+                state, superbatch, self._dispatch_key(), lrs,
+                jnp.asarray(self._poison_scalars(k)))
+            return new_state, StepMetrics(packed, guarded=True)
+        new_state, packed = cache[(bs, k)](
             state, superbatch, self._dispatch_key(), lrs)
         return new_state, StepMetrics(packed)
 

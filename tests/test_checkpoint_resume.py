@@ -407,7 +407,24 @@ def test_sigkill_and_resume_bitwise_identical(tmp_path, k):
     assert killed, "worker finished before it could be killed"
     assert not os.path.exists(out)
 
-    # resume: same command line, resume='auto' picks up the checkpoint
+    # a resumed run must REFUSE a checkpoint whose manifest lacks the
+    # known-good bit: strip it from the newest checkpoint and assert the
+    # resume entry point falls back to the previous (still known-good) one
+    mgr = CheckpointManager(prefix)
+    st0 = mgr.load_latest()        # newest VALID checkpoint (kill may have
+    assert st0 is not None         # torn the very last write)
+    assert st0.known_good is True
+    man_f = mgr._file(st0.tag, "manifest.json")
+    man = json.loads(open(man_f).read())
+    del man["known_good"]
+    atomic_write_bytes(man_f, json.dumps(man, indent=1).encode())
+    st = mgr.load_latest()
+    assert st is not None and st.tag != st0.tag, \
+        "resume must skip the manifest without the known-good bit"
+
+    # resume: same command line, resume='auto' picks up the newest
+    # known-good checkpoint (one interval earlier) and still replays to
+    # bitwise-identical final params
     p = launch(prefix, out)
     assert p.wait(timeout=600) == 0, p.stdout.read()
 

@@ -28,7 +28,12 @@ def _mlp():
 ZOO = [
     ("sgd", dict(momentum=0.9)),
     ("sgd", dict(momentum=0.0)),
+    # clip_gradient parity across the update families: the fused
+    # _fused_clip and the imperative nd.clip paths must produce identical
+    # params (guards clip_global_norm against the same drift)
     ("sgd", dict(momentum=0.9, clip_gradient=0.02)),
+    ("sgd", dict(momentum=0.0, clip_gradient=0.02)),
+    ("adam", dict(clip_gradient=0.02)),
     ("nag", dict(momentum=0.9)),
     ("dcasgd", dict(momentum=0.9)),
     ("adam", {}),

@@ -1,0 +1,743 @@
+"""Training-health guardrails (docs/robustness.md "Numerical guardrails").
+
+Pins the TrainingGuard contract: on-device NaN/Inf sentinels make a
+poisoned step a device-side no-op (bitwise — every other step identical to
+a run that never saw the bad batch), skipped batches stay out of metric
+denominators, the unguarded fused program is untouched (no sentinel ops, no
+retrace), sustained loss spikes roll training back to the newest KNOWN-GOOD
+checkpoint with the lr reduced, and ``max_rollbacks`` ends in
+``TrainingDivergedError``. Satellites: fused ``clip_global_norm`` parity
+vs. the imperative helper, the CrossEntropy eps device-sum gate,
+Speedometer health surfacing, known-good manifest refusal.
+"""
+import json
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, guard as guard_mod, optimizer as opt, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.executor import simple_bind
+from mxnet_tpu.guard import TrainingGuard, TrainingDivergedError
+from mxnet_tpu.model import CheckpointManager, atomic_write_bytes
+from mxnet_tpu.train_step import TrainStep
+
+pytestmark = pytest.mark.guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.clear()
+    guard_mod.TRAINING_HEALTH.reset()
+    yield
+    faults.clear()
+    guard_mod.TRAINING_HEALTH.reset()
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    net = sym.Activation(data=net, act_type="tanh")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _stacked(k=4, batch=8, seed=0):
+    rng = np.random.default_rng(seed)
+    Xs = rng.normal(size=(k, batch, 10)).astype(np.float32)
+    ys = rng.integers(0, 4, (k, batch)).astype(np.float32)
+    return Xs, ys
+
+
+def _mk_step(momentum=0.9, **kw):
+    o = opt.create("sgd", learning_rate=0.05, momentum=momentum,
+                   rescale_grad=1.0 / 8, **kw)
+    return TrainStep(_mlp(), optimizer=o)
+
+
+def _init(step, B=8, seed=1):
+    return step.init({"data": (B, 10)}, {"softmax_label": (B,)}, seed=seed)
+
+
+def _toy_data(n=128, dim=10, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, classes)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return X, y
+
+
+# -- on-device sentinels: parity and the bitwise no-op ----------------------
+
+def test_guarded_run_matches_unguarded_bitwise():
+    """Without faults, the guarded scan must produce the SAME params and
+    metric sums as the unguarded one (the sentinels observe, never touch)."""
+    K, B = 4, 8
+    Xs, ys = _stacked(K, B)
+    sb = {"data": jnp.asarray(Xs), "softmax_label": jnp.asarray(ys)}
+
+    a = _mk_step()
+    sa = _init(a)
+    sa, ma = a.run_steps(sa, sb)
+    b = _mk_step()
+    sb_state = _init(b)
+    sb_state, mb = b.run_steps(sb_state, dict(sb), guard=True)
+
+    for n in a.param_names:
+        np.testing.assert_array_equal(np.asarray(sa["params"][n]),
+                                      np.asarray(sb_state["params"][n]),
+                                      err_msg=n)
+    assert mb.skipped == 0
+    assert mb.num_samples == ma.num_samples == K * B
+    assert mb.loss_sum == ma.loss_sum
+    assert np.isfinite(mb.last_grad_norm)
+
+
+def test_grad_nan_step_is_bitwise_noop():
+    """Acceptance: with guard.grad_nan armed for step N, that step is a
+    device-side no-op — final params (and metric sums) bitwise-identical to
+    a run over the same batches WITHOUT batch N, skipped==1, params finite,
+    and the step counter does not advance for the skipped step."""
+    K, B = 4, 8
+    Xs, ys = _stacked(K, B)
+
+    faults.inject("guard.grad_nan", nth=2)      # poison step index 1
+    f = _mk_step()
+    sf = _init(f)
+    sf, mf = f.run_steps(sf, {"data": jnp.asarray(Xs),
+                              "softmax_label": jnp.asarray(ys)}, guard=True)
+    faults.clear()
+    assert mf.skipped == 1
+    assert mf.num_samples == (K - 1) * B        # metric denominator excludes
+    assert int(np.asarray(sf["step"])) == K - 1  # full no-op: clock too
+    for n in f.param_names:
+        assert np.isfinite(np.asarray(sf["params"][n])).all(), n
+
+    idx = [0, 2, 3]                              # same run minus the batch
+    r = _mk_step()
+    sr = _init(r)
+    sr, mr = r.run_steps(sr, {"data": jnp.asarray(Xs[idx]),
+                              "softmax_label": jnp.asarray(ys[idx])},
+                         guard=True)
+    for n in f.param_names:
+        np.testing.assert_array_equal(np.asarray(sf["params"][n]),
+                                      np.asarray(sr["params"][n]),
+                                      err_msg=n)
+    assert mf.loss_sum == mr.loss_sum
+    assert mf.top1_correct == mr.top1_correct
+
+
+def test_guarded_single_step_skip_and_sentinels():
+    B = 8
+    Xs, ys = _stacked(2, B)
+    batch = {"data": jnp.asarray(Xs[0]), "softmax_label": jnp.asarray(ys[0])}
+    s = _mk_step()
+    st = _init(s)
+    st, outs, packed = s.step(st, batch, guard=True)
+    sent = np.asarray(packed)
+    assert sent[2] == B and sent[3] == 0 and np.isfinite(sent[4])
+
+    faults.inject("guard.grad_nan", nth=1)
+    before = {n: np.asarray(st["params"][n]).copy() for n in s.param_names}
+    st, outs, packed = s.step(st, {"data": jnp.asarray(Xs[1]),
+                                   "softmax_label": jnp.asarray(ys[1])},
+                              guard=True)
+    sent = np.asarray(packed)
+    assert sent[3] == 1 and sent[2] == 0        # skipped, zero samples
+    for n in s.param_names:
+        np.testing.assert_array_equal(before[n], np.asarray(st["params"][n]),
+                                      err_msg=n)
+
+
+def test_guard_disabled_trace_and_caches_unchanged():
+    """Acceptance: with guard disabled the fused step's jaxpr has no
+    sentinel ops, and guarded dispatches never touch (or retrace) the
+    unguarded jit caches — still one compiled program per (batch, k)."""
+    K, B = 2, 8
+    Xs, ys = _stacked(K, B)
+    sb = {"data": jnp.asarray(Xs), "softmax_label": jnp.asarray(ys)}
+    s = _mk_step()
+    st = _init(s)
+
+    fn = s._make_step_fn(B)
+    jaxpr = str(jax.make_jaxpr(lambda a, b, k_, lr: fn(a, b, k_, lr))(
+        st, {"data": jnp.asarray(Xs[0]), "softmax_label": jnp.asarray(ys[0])},
+        jax.random.key(0), jnp.float32(0.1)))
+    assert "is_finite" not in jaxpr
+
+    st, _ = s.run_steps(st, dict(sb))
+    st, _ = s.run_steps(st, dict(sb), guard=True)
+    st, _ = s.run_steps(st, dict(sb))
+    assert set(s._jit_scan) == {(B, K)}
+    assert set(s._jit_scan_g) == {(B, K)}
+    for f in list(s._jit_scan.values()) + list(s._jit_scan_g.values()):
+        assert f._cache_size() == 1, "guard toggling retraced a scan"
+
+
+# -- fused clip_global_norm (satellite) -------------------------------------
+
+def test_clip_global_norm_fused_matches_imperative():
+    """Fused in-graph global-norm clip == imperative clip_by_global_norm
+    over the same (pre-scaled) gradients, SGD with momentum."""
+    B, c = 8, 0.05
+    Xs, ys = _stacked(3, B, seed=7)
+    fused = _mk_step(clip_global_norm=c)
+    state = _init(fused, seed=2)
+
+    ex = simple_bind(_mlp(), mx.cpu(), grad_req="write", data=(B, 10),
+                     softmax_label=(B,))
+    for n in fused.param_names:
+        ex.arg_dict[n]._set_data(jnp.copy(state["params"][n]))
+    imp = opt.create("sgd", learning_rate=0.05, momentum=0.9,
+                     rescale_grad=1.0)   # grads pre-scaled below
+    upd = opt.get_updater(imp)
+    names = list(fused.param_names)
+
+    for i in range(3):
+        batch = {"data": jnp.asarray(Xs[i]),
+                 "softmax_label": jnp.asarray(ys[i])}
+        state, _ = fused.step(state, batch)
+        ex.forward(is_train=True, data=Xs[i], softmax_label=ys[i])
+        ex.backward()
+        grads = [ex.grad_dict[n] * (1.0 / B) for n in names]
+        opt.clip_by_global_norm(grads, c)
+        for j, n in enumerate(names):
+            upd(j, grads[j], ex.arg_dict[n])
+
+    for n in names:
+        np.testing.assert_allclose(np.asarray(state["params"][n]),
+                                   ex.arg_dict[n].asnumpy(),
+                                   atol=2e-5, rtol=2e-5, err_msg=n)
+
+
+def test_clip_by_global_norm_scales_and_reports_norm():
+    a = mx.nd.array(np.full((3,), 3.0, np.float32))
+    b = mx.nd.array(np.full((4,), 2.0, np.float32))
+    norm = opt.clip_by_global_norm([a, b], 1.0)
+    np.testing.assert_allclose(norm, np.sqrt(9 * 3 + 4 * 4), rtol=1e-6)
+    np.testing.assert_allclose(opt.global_norm([a, b]), 1.0, rtol=1e-5)
+
+
+def test_imperative_updater_rejects_clip_global_norm():
+    o = opt.create("sgd", learning_rate=0.1, clip_global_norm=1.0)
+    upd = opt.get_updater(o)
+    w = mx.nd.array(np.ones((2,), np.float32))
+    g = mx.nd.array(np.ones((2,), np.float32))
+    with pytest.raises(MXNetError, match="clip_by_global_norm"):
+        upd(0, g, w)
+
+
+# -- fit()-level guard: skip, health, metric denominators -------------------
+
+def _guarded_fit(X, y, k, guard, num_epoch=1, prefix=None, every=None,
+                 lr=0.1, seed=3):
+    mx.random.seed(seed)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    metric = mx.metric.create(["acc", "ce"])
+    mod.fit(train, num_epoch=num_epoch, eval_metric=metric,
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            steps_per_dispatch=k, guard=guard,
+            checkpoint_prefix=prefix,
+            checkpoint_every_n_batches=every, checkpoint_keep=10)
+    return mod, metric
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_fit_guard_skips_nan_batch_and_stays_finite(k):
+    X, y = _toy_data()
+    faults.inject("guard.grad_nan", nth=3)
+    g = TrainingGuard(max_skips_per_window=100)
+    mod, metric = _guarded_fit(X, y, k, g)
+    assert g.health.skipped == 1
+    assert g.health.steps == 8
+    arg, _ = mod.get_params()
+    for n, v in arg.items():
+        assert np.isfinite(v.asnumpy()).all(), n
+    # the skipped batch is excluded from the metric denominator
+    for m in metric.metrics:
+        assert m.num_inst == 128 - 16
+    # and the process-global aggregate mirrored it
+    assert guard_mod.TRAINING_HEALTH.report()["skipped"] == 1
+
+
+def test_fit_guard_true_and_env_default(caplog, monkeypatch):
+    X, y = _toy_data(64)
+
+    def run():
+        mx.random.seed(0)
+        train = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        with caplog.at_level(logging.WARNING):
+            mod.fit(train, num_epoch=1,
+                    optimizer_params={"learning_rate": 0.1}, guard=None)
+
+    # guard=None + no env: silent, unguarded
+    run()
+    assert not any("checkpoint_prefix" in r.message for r in caplog.records)
+    # MXTPU_GUARD=1 turns the guard on by default: without checkpoints it
+    # trains but warns that divergence cannot roll back
+    monkeypatch.setenv("MXTPU_GUARD", "1")
+    run()
+    assert any("no checkpoint_prefix" in r.message for r in caplog.records)
+
+
+def test_fit_guard_ineligible_warns_and_trains_unguarded(caplog):
+    # multi-head net: no single classification head -> guard unavailable
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    a = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4, name="ha"),
+                          name="sa")
+    b = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4, name="hb"),
+                          name="sb")
+    net = sym.Group([a, b])
+    X, y = _toy_data(32)
+    train = mx.io.NDArrayIter(X, {"sa_label": y, "sb_label": y},
+                              batch_size=16)
+    mod = mx.mod.Module(net, label_names=("sa_label", "sb_label"),
+                        context=mx.cpu())
+    g = TrainingGuard()
+    with caplog.at_level(logging.WARNING):
+        mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1},
+                guard=g)
+    assert any("UNGUARDED" in r.message for r in caplog.records)
+    assert g.health.steps == 0
+
+
+# -- divergence -> rollback -> TrainingDivergedError ------------------------
+
+def test_loss_spike_triggers_rollback_and_lr_reduction(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    g = TrainingGuard(patience=2, max_rollbacks=1, lr_factor=0.5)
+    faults.inject("guard.loss_spike", nth=6, times=2)
+    mod, _ = _guarded_fit(X, y, 1, g, num_epoch=2, prefix=prefix, every=3)
+    assert g.health.rollbacks == 1
+    assert g.health.divergences == 1
+    assert abs(mod._optimizer.lr - 0.05) < 1e-12    # 0.1 * 0.5
+    arg, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())
+
+
+def test_rollback_restores_checkpoint_bitwise(tmp_path):
+    """The rollback hook itself: params, optimizer momentum and the update
+    clock all come back bitwise from the last known-good checkpoint, and
+    the lr is reduced by the policy factor."""
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    g = TrainingGuard(lr_factor=0.25)
+    mod, _ = _guarded_fit(X, y, 1, g, prefix=prefix, every=4)
+    mgr = CheckpointManager(prefix, keep=10)
+    want = mgr.load_latest()
+    assert want is not None and want.known_good is True
+    clock_before = mod._optimizer.num_update
+
+    # keep training so live params drift away from the checkpoint
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    for batch in train:
+        assert mod._try_fused_fit_step(batch)
+    drifted, _ = mod.get_params()
+    assert any(not np.array_equal(drifted[n].asnumpy(),
+                                  want.arg_params[n].asnumpy())
+               for n in drifted)
+
+    g.diverged = True
+    g.diverged_reason = "test"
+    st = mod._guard_rollback(g, mgr)
+    assert st.tag == want.tag
+    arg, _ = mod.get_params()
+    for n in arg:
+        np.testing.assert_array_equal(arg[n].asnumpy(),
+                                      want.arg_params[n].asnumpy(),
+                                      err_msg=n)
+    assert mod._optimizer.num_update == want.num_update != clock_before + 8
+    assert abs(mod._optimizer.lr - 0.1 * 0.25) < 1e-12
+    assert g.health.rollbacks == 1 and not g.diverged
+    # optimizer momentum restored: the next fused step reseeds from the
+    # checkpointed updater states, bitwise
+    train.reset()
+    batch = next(iter(train))
+    assert mod._try_fused_fit_step(batch)
+    assert int(np.asarray(mod._fused_state["step"])) == want.num_update + 1
+
+
+def test_rollback_under_dispatch_bulking(tmp_path):
+    """Divergence mid-epoch under steps_per_dispatch=4: the superbatch
+    iterator resets cleanly mid-stream, the rollback fast-forwards whole
+    dispatches, and training completes at the reduced lr."""
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    g = TrainingGuard(patience=1, max_rollbacks=1, lr_factor=0.5)
+    faults.inject("guard.loss_spike", nth=2)     # 2nd dispatch observation
+    mod, _ = _guarded_fit(X, y, 4, g, num_epoch=2, prefix=prefix, every=4)
+    assert g.health.rollbacks == 1
+    assert abs(mod._optimizer.lr - 0.05) < 1e-12
+    arg, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())
+    # training resumed and finished both epochs after the rollback
+    assert int(np.asarray(mod._fused_state["step"])) == 16
+
+
+def test_max_rollbacks_exhausted_raises_diverged(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    g = TrainingGuard(patience=2, max_rollbacks=0)
+    faults.inject("guard.loss_spike", nth=6, times=2)
+    with pytest.raises(TrainingDivergedError, match="max_rollbacks"):
+        _guarded_fit(X, y, 1, g, num_epoch=2, prefix=prefix, every=3)
+    assert g.health.divergences == 1 and g.health.rollbacks == 0
+
+
+def test_divergence_without_checkpoint_raises(tmp_path):
+    X, y = _toy_data()
+    g = TrainingGuard(patience=2)
+    faults.inject("guard.loss_spike", nth=4, times=2)
+    with pytest.raises(TrainingDivergedError, match="checkpoint_prefix"):
+        _guarded_fit(X, y, 1, g, num_epoch=1)
+
+
+def test_skip_storm_triggers_divergence(tmp_path):
+    """>= max_skips_per_window skipped batches inside one window is a
+    divergence signal too (the data, not the lr, has gone bad)."""
+    X, y = _toy_data()
+    g = TrainingGuard(max_skips_per_window=2, window=50)
+    faults.inject("guard.grad_nan", nth=3, times=2)
+    with pytest.raises(TrainingDivergedError, match="skipped"):
+        _guarded_fit(X, y, 1, g, num_epoch=1)
+    assert g.health.skipped == 2
+
+
+def test_checkpoints_deferred_while_spiking(tmp_path):
+    """A state inside the spike window must not be sealed as a checkpoint:
+    the rollback target has to PREdate the divergence it is escaping."""
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    g = TrainingGuard(patience=3, max_rollbacks=1)
+    faults.inject("guard.loss_spike", nth=5, times=3)  # obs 5-7 spike
+    _guarded_fit(X, y, 1, g, num_epoch=1, prefix=prefix, every=2)
+    assert g.health.rollbacks == 1
+    # cadence would have saved b6 mid-spike; it was deferred, so the
+    # rollback landed on b4 — the last pre-spike state
+    assert g.health.last_event == "rolled back to checkpoint e0000-b00000004"
+
+
+class _Stop(Exception):
+    pass
+
+
+def test_guarded_resume_restores_noise_clock_after_skip(tmp_path):
+    """A guard-skipped step leaves the device step clock one behind
+    num_update. Resume must restore the DEVICE clock (Adam's t, noise
+    streams) from the manifest's fused_step, not re-derive it from
+    num_update — asserted by bitwise parity of an interrupted+resumed
+    guarded Adam run against an uninterrupted one."""
+    X, y = _toy_data(64)
+
+    def run(prefix, interrupt_after=None, resume=None, inject=True):
+        faults.clear()
+        if inject:
+            faults.inject("guard.grad_nan", nth=2)
+        mx.random.seed(3)
+        train = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        g = TrainingGuard(max_skips_per_window=100)
+        cb = None
+        if interrupt_after is not None:
+            def cb(p):
+                if p.nbatch + 1 >= interrupt_after:
+                    raise _Stop()
+        try:
+            mod.fit(train, num_epoch=1, optimizer="adam",
+                    optimizer_params={"learning_rate": 0.01}, guard=g,
+                    batch_end_callback=cb, checkpoint_prefix=prefix,
+                    checkpoint_every_n_batches=3, resume=resume)
+        except _Stop:
+            pass
+        faults.clear()
+        arg, _ = mod.get_params()
+        return {n: v.asnumpy() for n, v in arg.items()}
+
+    ref = run(str(tmp_path / "ref"))
+    run(str(tmp_path / "vic"), interrupt_after=3)
+    # the checkpoint recorded both clocks: 3 host updates, 2 device steps
+    st = CheckpointManager(str(tmp_path / "vic")).load_latest()
+    assert st.num_update == 3 and st.fused_step == 2
+    got = run(str(tmp_path / "vic"), resume="auto", inject=False)
+    for n in ref:
+        np.testing.assert_array_equal(ref[n], got[n], err_msg=n)
+
+
+# -- known-good manifests ----------------------------------------------------
+
+def _fit_with_ckpt(X, y, prefix, every=4):
+    mx.random.seed(0)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1,
+                                                  "momentum": 0.9},
+            checkpoint_prefix=prefix, checkpoint_every_n_batches=every,
+            checkpoint_keep=10)
+    return mod
+
+
+def test_checkpoints_marked_known_good(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _fit_with_ckpt(X, y, prefix)
+    mgr = CheckpointManager(prefix)
+    for tag in mgr.list_tags():
+        man = json.loads(open(mgr._file(tag, "manifest.json")).read())
+        assert man["known_good"] is True and man["version"] == 2
+
+
+def test_nonfinite_params_not_marked_known_good(tmp_path, caplog):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    mod = _fit_with_ckpt(X, y, prefix)
+    mgr = CheckpointManager(prefix, keep=10)
+    good = mgr.load_latest()
+    # poison a live param, then checkpoint: saved but NOT known-good
+    arg, aux = mod.get_params()
+    bad = arg["fc1_weight"].asnumpy().copy()
+    bad[0, 0] = np.nan
+    arg["fc1_weight"] = mx.nd.array(bad)
+    mod.set_params(arg, aux)
+    with caplog.at_level(logging.WARNING):
+        tag = mgr.save(mod, 7, 0)
+    man = json.loads(open(mgr._file(tag, "manifest.json")).read())
+    assert man["known_good"] is False
+    assert any("NOT all finite" in r.message for r in caplog.records)
+    # resume/rollback refuses it and falls back to the known-good one
+    with caplog.at_level(logging.WARNING):
+        st = mgr.load_latest()
+    assert st.tag == good.tag
+    assert any("known-good" in r.message for r in caplog.records)
+    # forensics path still reaches it
+    assert mgr.load_latest(require_known_good=False).tag == tag
+
+
+def test_param_nan_fault_site_unmarks_checkpoint(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    mod = _fit_with_ckpt(X, y, prefix)
+    mgr = CheckpointManager(prefix, keep=10)
+    faults.inject("guard.param_nan", nth=1)
+    tag = mgr.save(mod, 8, 0)
+    man = json.loads(open(mgr._file(tag, "manifest.json")).read())
+    assert man["known_good"] is False
+
+
+def test_prune_never_deletes_newest_known_good(tmp_path):
+    """A numerically dead run keeps writing post-mortem (not-known-good)
+    checkpoints; age-only retention would push the last RESUMABLE state
+    out of the keep window and resume would silently restart from
+    scratch."""
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    mod = _fit_with_ckpt(X, y, prefix, every=None)   # one good epoch-end tag
+    mgr = CheckpointManager(prefix, keep=2)
+    good = mgr.load_latest()
+    assert good is not None
+    # three post-mortem saves (params "went non-finite" via the fault site)
+    for i in range(3):
+        faults.inject("guard.param_nan", nth=1)
+        mgr.save(mod, 10 + i, 0)
+    tags = mgr.list_tags()
+    assert good.tag in tags, "newest known-good tag was pruned"
+    assert len(tags) == 3                   # keep=2 bad tags + the good one
+    st = mgr.load_latest()
+    assert st is not None and st.tag == good.tag
+
+
+def test_resume_refuses_manifest_without_known_good_bit(tmp_path, caplog):
+    """A manifest that LACKS the bit (pre-guard format) is refused for
+    resume: the newest checkpoint that can prove finite params wins."""
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _fit_with_ckpt(X, y, prefix)
+    mgr = CheckpointManager(prefix, keep=10)
+    tags = mgr.list_tags()
+    man_f = mgr._file(tags[-1], "manifest.json")
+    man = json.loads(open(man_f).read())
+    del man["known_good"]
+    atomic_write_bytes(man_f, json.dumps(man, indent=1).encode())
+    with caplog.at_level(logging.WARNING):
+        st = mgr.load_latest()
+    assert st is not None and st.tag == tags[-2]
+    assert any("known-good" in r.message for r in caplog.records)
+
+
+# -- metric eps gate (satellite) --------------------------------------------
+
+def test_device_sums_rejects_nondefault_ce_eps():
+    m = mx.metric.CrossEntropy(eps=1e-5)
+    with pytest.raises(MXNetError) as ei:
+        mx.metric.supports_device_sums(m)
+    msg = str(ei.value)
+    assert "cross-entropy" in msg and "1e-05" in msg and "1e-8" in msg
+    # default eps still rides the device-sum path; composites propagate
+    assert mx.metric.supports_device_sums(mx.metric.CrossEntropy())
+    comp = mx.metric.create(["acc", "ce"])
+    assert mx.metric.supports_device_sums(comp)
+    comp.add(mx.metric.CrossEntropy(eps=1e-5))
+    with pytest.raises(MXNetError, match="eps"):
+        mx.metric.supports_device_sums(comp)
+    # order-independent: the rejection fires with the CE in ANY position
+    comp2 = mx.metric.CompositeEvalMetric(
+        [mx.metric.CrossEntropy(eps=1e-5), mx.metric.Accuracy()])
+    with pytest.raises(MXNetError, match="eps"):
+        mx.metric.supports_device_sums(comp2)
+    # ...but NOT when a sibling already forces the per-step fallback
+    # (where any eps works — raising would demand a fix that can't help)
+    comp3 = mx.metric.CompositeEvalMetric(
+        [mx.metric.MSE(), mx.metric.CrossEntropy(eps=1e-5)])
+    assert mx.metric.supports_device_sums(comp3) is False
+
+
+def test_fit_nondefault_ce_eps_rejected_under_bulking():
+    X, y = _toy_data(64)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(MXNetError, match="steps_per_dispatch=1"):
+        mod.fit(train, num_epoch=1,
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=mx.metric.CrossEntropy(eps=1e-5),
+                steps_per_dispatch=4)
+
+
+def test_fit_nondefault_ce_eps_ok_when_bulking_ineligible(caplog):
+    """The eps rejection must only fire when the run would otherwise take
+    the device-sum path: a module that can't bulk anyway (multi-head)
+    falls back per-step, where the host metric honors any eps."""
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    a = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4, name="ha"),
+                          name="sa")
+    b = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4, name="hb"),
+                          name="sb")
+    X, y = _toy_data(32)
+    train = mx.io.NDArrayIter(X, {"sa_label": y, "sb_label": y},
+                              batch_size=16)
+    mod = mx.mod.Module(sym.Group([a, b]),
+                        label_names=("sa_label", "sb_label"),
+                        context=mx.cpu())
+    with caplog.at_level(logging.WARNING):
+        mod.fit(train, num_epoch=1,
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=mx.metric.CrossEntropy(eps=1e-5),
+                steps_per_dispatch=4)
+    assert any("steps_per_dispatch=4 unavailable" in r.message
+               for r in caplog.records)
+
+
+# -- observability (satellite) ----------------------------------------------
+
+def _fire_speedometer(locals_):
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.module.base_module import BatchEndParam
+    sp = Speedometer(batch_size=16, frequent=10)
+    fired = []
+    orig = logging.info
+    logging.info = lambda *a: fired.append(a)
+    try:
+        for nbatch in (5, 15):
+            sp(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                             locals=locals_))
+    finally:
+        logging.info = orig
+    assert fired, "speedometer never fired"
+    return " ".join(str(x) for call in fired for x in call)
+
+
+def test_speedometer_surfaces_training_health():
+    g = TrainingGuard(logger=logging.getLogger("quiet"))
+    g.health.record_steps(100, 2, 0.43)
+    g.health.record_rollback("e0001-b00000004")
+    joined = _fire_speedometer({"guard": g})   # fit exposes its locals
+    assert "skipped=2" in joined and "rollbacks=1" in joined \
+        and "grad_norm=0.43" in joined
+
+
+def test_speedometer_strictly_per_run():
+    """Another run's counters must never leak in: an unguarded fit
+    (guard=None in locals) and a hand-built BatchEndParam (score()'s
+    locals have no guard) both stay clean even while the process-global
+    aggregate holds counts from an earlier guarded run."""
+    guard_mod.TRAINING_HEALTH.record_steps(100, 2, 0.43)
+    guard_mod.TRAINING_HEALTH.record_rollback("e0001-b00000004")
+    assert "Guard:" not in _fire_speedometer({"guard": None})
+    assert "Guard:" not in _fire_speedometer({"other": 1})
+    assert "Guard:" not in _fire_speedometer(None)
+    # and a guarded run with nothing to report is quiet too
+    assert "Guard:" not in _fire_speedometer({"guard": TrainingGuard()})
+
+
+# -- policy knobs ------------------------------------------------------------
+
+def test_guard_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXTPU_GUARD_WINDOW", "25")
+    monkeypatch.setenv("MXTPU_GUARD_SPIKE_FACTOR", "3.5")
+    monkeypatch.setenv("MXTPU_GUARD_PATIENCE", "7")
+    monkeypatch.setenv("MXTPU_GUARD_MAX_SKIPS", "9")
+    monkeypatch.setenv("MXTPU_GUARD_LR_FACTOR", "0.25")
+    monkeypatch.setenv("MXTPU_GUARD_MAX_ROLLBACKS", "4")
+    g = TrainingGuard()
+    assert (g.window, g.spike_factor, g.patience, g.max_skips_per_window,
+            g.lr_factor, g.max_rollbacks) == (25, 3.5, 7, 9, 0.25, 4)
+    # explicit args win over env
+    assert TrainingGuard(patience=2).patience == 2
+    monkeypatch.setenv("MXTPU_GUARD_WINDOW", "bogus")
+    with pytest.raises(MXNetError, match="MXTPU_GUARD_WINDOW"):
+        TrainingGuard()
+
+
+def test_guard_env_disable_spellings(monkeypatch):
+    """MXTPU_GUARD=False/OFF/No must DISABLE, not enable (case folded)."""
+    X, y = _toy_data(32)
+    for spelling in ("False", "OFF"):
+        monkeypatch.setenv("MXTPU_GUARD", spelling)
+        mx.random.seed(0)
+        train = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(train, num_epoch=1,
+                optimizer_params={"learning_rate": 0.1})
+        assert not mod._fused._jit_g, \
+            "MXTPU_GUARD=%r must not enable the guard" % spelling
+
+
+def test_nonfinite_loss_observation_skipped_with_warning():
+    """A NaN loss observation (non-probability head slipping the shape
+    gate) must not poison the EMA and kill the watcher silently."""
+    g = TrainingGuard(patience=2, spike_factor=2.0,
+                      logger=logging.getLogger("capture"))
+    g.on_dispatch(loss_sum=1.0, nsamp=1, skipped=0, grad_norm=0.1)
+    ema = g._ema
+    g.on_dispatch(loss_sum=float("nan"), nsamp=1, skipped=0, grad_norm=0.1)
+    assert g._ema == ema and g._warned_nonfinite_loss
+    # the watcher still works afterwards: two real spikes diverge
+    for _ in range(2):
+        g.on_dispatch(loss_sum=100.0, nsamp=1, skipped=0, grad_norm=0.1)
+    assert g.diverged
+
+
+def test_guard_rejects_bad_policy():
+    with pytest.raises(MXNetError, match="lr_factor"):
+        TrainingGuard(lr_factor=0.0)
+    with pytest.raises(MXNetError, match="patience"):
+        TrainingGuard(patience=0)
+
+
+def test_spiked_observation_never_updates_ema():
+    g = TrainingGuard(patience=3, spike_factor=2.0,
+                      logger=logging.getLogger("quiet"))
+    for _ in range(3):
+        g.on_dispatch(loss_sum=1.0, nsamp=1, skipped=0, grad_norm=0.1)
+    ema = g._ema
+    g.on_dispatch(loss_sum=100.0, nsamp=1, skipped=0, grad_norm=0.1)
+    assert g._ema == ema and g._spike_run == 1 and not g.diverged
+    g.on_dispatch(loss_sum=1.0, nsamp=1, skipped=0, grad_norm=0.1)
+    assert g._spike_run == 0
