@@ -24,6 +24,17 @@ bfloat16), BENCH_DEPTH (default 50), BENCH_IMAGE (default 224),
 BENCH_STEPS_PER_DISPATCH (default 1; >=2 enables the steady-state bulked
 mode: K steps per lax.scan dispatch over a device-resident superbatch with
 metrics read back once per K — docs/perf.md "Dispatch bulking").
+
+BENCH_HOST_OVERHEAD=1 switches to the host-overhead mode (docs/perf.md
+"Host off the critical path"): a full Module.fit loop with checkpointing
+enabled, swept over BENCH_CKPT_CADENCES (default "8,16"), measuring
+steady-state img/s and host_stall_frac — the fraction of wall time the
+loop spent blocked on the host (packed-metric readbacks + checkpoint
+serialization) — for the sync/eager baseline vs async checkpointing +
+pipelined dispatch. Extra knobs: BENCH_HO_BATCHES (batches/epoch, default
+32), BENCH_HO_IMAGE (default 112), BENCH_HO_BATCH (default 64),
+BENCH_STEPS_PER_DISPATCH (default 4 in this mode),
+MXTPU_DISPATCH_PIPELINE (depth for the pipelined config, default 1).
 """
 import json
 import os
@@ -53,6 +64,101 @@ def _peak_flops(device):
         if kind.startswith(k):
             return v, kind
     return None, kind
+
+
+def host_overhead_main():
+    """Host-overhead mode: measure what checkpointing + metric readback
+    COST the train loop, and how much of it the async writer + dispatch
+    pipeline hide. One JSON line:
+
+        {"metric": "...host_overhead...", "value": <best async img/s>,
+         "host_stall_frac": <that same best-async config's frac>,
+         "sweep": [{"cadence": N, "sync": {...}, "async": {...}}, ...]}
+
+    Each config trains epoch 1 as compile/warmup and measures epoch 2's
+    wall clock; host_stall_frac = (packed-readback stall + checkpoint
+    save time on the loop thread) / epoch wall."""
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.model import CheckpointManager
+
+    batch = int(os.environ.get("BENCH_HO_BATCH", "64"))
+    image = int(os.environ.get("BENCH_HO_IMAGE", "112"))
+    depth = int(os.environ.get("BENCH_DEPTH", "50"))
+    k = int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "4"))
+    nbatches = int(os.environ.get("BENCH_HO_BATCHES", "32"))
+    cadences = [int(c) for c in
+                os.environ.get("BENCH_CKPT_CADENCES", "8,16").split(",")
+                if c.strip()]
+    from mxnet_tpu import engine
+    pl_depth = engine.dispatch_pipeline()
+
+    sym = models.resnet(num_classes=1000, num_layers=depth,
+                        image_shape="3,%d,%d" % (image, image))
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(nbatches * batch, 3, image, image)) \
+        .astype(np.float32)
+    y = rng.integers(0, 1000, nbatches * batch).astype(np.float32)
+
+    def run(cadence, pipelined, async_ckpt, tmpdir, tag):
+        mx.random.seed(0)
+        it = mx.io.NDArrayIter(X, y, batch_size=batch)
+        mod = mx.mod.Module(sym, context=mx.cpu()
+                            if jax_platform() == "cpu" else None)
+        mgr = CheckpointManager(os.path.join(tmpdir, tag, "ck"), keep=2)
+        caps = {}
+
+        def cb(p):
+            caps["pipeline"] = p.locals.get("pipeline")
+
+        marks = {}
+
+        def epoch_cb(epoch, *_a):
+            p = caps.get("pipeline")
+            marks[epoch] = (time.perf_counter(),
+                            getattr(p, "host_stall", 0.0), mgr.save_time)
+
+        mod.fit(it, num_epoch=2, steps_per_dispatch=k,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                checkpoint_prefix=mgr, checkpoint_every_n_batches=cadence,
+                checkpoint_async=async_ckpt,
+                dispatch_pipeline=pl_depth if pipelined else 0,
+                batch_end_callback=cb, epoch_end_callback=epoch_cb)
+        (t0, s0, c0), (t1, s1, c1) = marks[0], marks[1]
+        wall = t1 - t0
+        stall = (s1 - s0) + (c1 - c0)
+        writer = mgr.async_writer or mgr.last_async_writer
+        return {"images_per_sec": round(nbatches * batch / wall, 2),
+                "host_stall_frac": round(max(0.0, stall) / wall, 4),
+                "ckpt_skipped": writer.skipped if writer else 0}
+
+    def jax_platform():
+        import jax
+        return jax.devices()[0].platform
+
+    sweep = []
+    best_async = None
+    with tempfile.TemporaryDirectory() as tmpdir:
+        for cadence in cadences:
+            sync = run(cadence, False, False, tmpdir, "sync-%d" % cadence)
+            asyn = run(cadence, True, True, tmpdir, "async-%d" % cadence)
+            sweep.append({"cadence": cadence, "sync": sync, "async": asyn})
+            if best_async is None or (asyn["images_per_sec"]
+                                      > best_async["images_per_sec"]):
+                best_async = asyn
+
+    out = {
+        "metric": "resnet%d_host_overhead_b%d_k%d" % (depth, batch, k),
+        "value": best_async["images_per_sec"],
+        "unit": "images/sec",
+        "steps_per_dispatch": k,
+        "pipeline_depth": pl_depth,
+        "host_stall_frac": best_async["host_stall_frac"],
+        "sweep": sweep,
+    }
+    print(json.dumps(out))
 
 
 def main():
@@ -203,4 +309,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_HOST_OVERHEAD", "").strip() not in ("", "0"):
+        host_overhead_main()
+    else:
+        main()

@@ -83,6 +83,15 @@ def env_float(name, default):
         raise MXNetError("%s must be a number, got %r" % (name, v))
 
 
+def env_bool(name):
+    """Parse an env var as an on/off switch (MXTPU_GUARD / MXTPU_ASYNC_CKPT
+    share this so the disable spellings can never drift apart): unset,
+    blank, and the usual "off" spellings are False, anything else True."""
+    import os
+    return os.environ.get(name, "").strip().lower() \
+        not in ("", "0", "false", "off", "no")
+
+
 def attr_str(v, default=None):
     if v is _NULL or v is None:
         return default

@@ -187,6 +187,27 @@ def MXImperativeInvoke(op_name, input_handles, attrs):
     return [_new_handle(o) for o in outs]
 
 
+@_capi
+def MXImperativeInvokeInPlace(op_name, input_handles, attrs,
+                              output_handles):
+    """The ``*outputs != NULL`` half of the reference MXImperativeInvoke
+    contract (ref: src/c_api/c_api_ndarray.cc:322): results are written IN
+    PLACE into the caller's existing NDArray handles (``out=`` semantics)
+    — the handles keep identifying the same NDArrays, whose storage is
+    updated. A count mismatch fails loudly instead of truncating."""
+    from .ops import get as get_op
+    from .ndarray import invoke
+    opdef = get_op(op_name)
+    inputs = [_get(h) for h in input_handles]
+    targets = [_get(h) for h in output_handles]
+    # invoke()'s out= path validates count/shape/dtype BEFORE any write
+    # (fails loudly instead of reshaping/casting the caller's buffers) and
+    # records the targets themselves with autograd — a manual copy of the
+    # results here would leave the out handles off the recorded graph
+    invoke(opdef, inputs, dict(attrs or {}), out=targets)
+    return len(targets)
+
+
 # -- Symbol ----------------------------------------------------------------
 
 @_capi

@@ -61,6 +61,7 @@ class Speedometer(object):
         self.tic = 0
         self.last_count = 0
         self._fired = 0
+        self._stall_seen = 0.0  # pipeline host_stall at the last fire
 
     @staticmethod
     def _health_suffix(param):
@@ -81,6 +82,28 @@ class Speedometer(object):
         return ("\tGuard: skipped=%d rollbacks=%d grad_norm=%s"
                 % (h["skipped"], h["rollbacks"], gn))
 
+    def _pipeline_suffix(self, param):
+        """THIS run's dispatch-pipeline counters (docs/perf.md "Host off
+        the critical path"): depth plus the host-stall seconds spent
+        blocked in packed-readbacks since the last fire — read strictly
+        via ``param.locals`` like the Guard suffix, so one run's counters
+        never leak into another's lines. Empty in eager mode."""
+        loc = getattr(param, "locals", None)
+        p = loc.get("pipeline") if isinstance(loc, dict) else None
+        if p is None or getattr(p, "depth", 0) <= 0:
+            # an eager pipeline still advances the baseline; a param from
+            # another callback stream (no pipeline in locals) must NOT
+            # reset it — that would attribute the pipelined run's whole
+            # accumulated stall to its next window
+            if p is not None:
+                self._stall_seen = p.host_stall or 0.0
+            return ""
+        stall = p.host_stall
+        window = max(0.0, stall - self._stall_seen)
+        self._stall_seen = stall
+        return ("\tPipeline: depth=%d host_stall=%.3fs"
+                % (p.depth, window))
+
     def __call__(self, param):
         count = param.nbatch
         if self.last_count > count:
@@ -94,7 +117,8 @@ class Speedometer(object):
             if count // self.frequent > self._fired // self.frequent:
                 speed = ((count - self._fired) * self.batch_size
                          / (time.time() - self.tic))
-                health = self._health_suffix(param)
+                health = self._health_suffix(param) \
+                    + self._pipeline_suffix(param)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     param.eval_metric.reset()
@@ -113,6 +137,9 @@ class Speedometer(object):
             self.init = True
             self._fired = count
             self.tic = time.time()
+            # baseline the pipeline stall counter so the first fired
+            # window reports its own stall, not the whole run-up
+            self._pipeline_suffix(param)
 
 
 class ProgressBar(object):

@@ -65,6 +65,38 @@ def bulk(size):
         set_bulk_size(prev)
 
 
+_pipeline_override = None
+
+
+def dispatch_pipeline():
+    """Default deferred-readback depth for K-step dispatch (docs/perf.md
+    "Host off the critical path"): ``Module.fit`` enqueues dispatch
+    N+depth before fetching dispatch N's packed metric/sentinel array, so
+    the device never idles waiting on the host between dispatches. 0 =
+    eager (fetch immediately after each dispatch). Env default:
+    ``MXTPU_DISPATCH_PIPELINE`` (1)."""
+    if _pipeline_override is not None:
+        return _pipeline_override
+    v = os.environ.get("MXTPU_DISPATCH_PIPELINE")
+    if v is None or v.strip() == "":
+        return 1
+    try:
+        return max(0, int(v))
+    except ValueError:
+        from .base import MXNetError
+        raise MXNetError(
+            "MXTPU_DISPATCH_PIPELINE must be an integer, got %r" % v)
+
+
+def set_dispatch_pipeline(depth):
+    """Override the default dispatch-pipeline depth (None = back to the
+    env/default); returns the previous effective value."""
+    global _pipeline_override
+    prev = dispatch_pipeline()
+    _pipeline_override = None if depth is None else max(0, int(depth))
+    return prev
+
+
 def maybe_sync(arr):
     """Called after each imperative op; blocks in naive mode."""
     if _naive and arr is not None:

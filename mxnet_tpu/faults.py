@@ -19,6 +19,14 @@ site                         where it fires
 ``superbatch.producer``      top of the SuperBatchIter producer loop
 ``checkpoint.write``         before an atomic checkpoint file write
 ``checkpoint.write.mid``     mid-stream, after half the payload is written
+``ckpt.async_write``         on the async checkpoint writer thread, before
+                             a submitted save writes its first byte
+                             (raise/transient => the save is dropped and
+                             counted; ``latest`` keeps the previous
+                             generation)
+``ckpt.async_die``           top of an async save on the writer thread —
+                             ``"die"`` kills the thread abruptly mid-job
+                             (the next submit/drain reaps and restarts it)
 ``kvstore.push``             before a KVStore push
 ``kvstore.pull``             before a KVStore pull
 ``kvstore.barrier``          before a KVStore barrier

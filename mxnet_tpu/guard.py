@@ -71,9 +71,21 @@ class TrainingHealth(object):
         self.skipped = 0
         self.divergences = 0
         self.rollbacks = 0
+        self.ckpt_skipped = 0
         self.last_grad_norm = None
         self.last_loss = None
         self.last_event = None
+
+    def record_ckpt_skip(self):
+        """An async checkpoint save was shed under back-pressure (the
+        previous save was still in flight — model.AsyncCheckpointWriter).
+        Counted here so a run quietly losing checkpoint cadence to a slow
+        disk is diagnosable from its health report."""
+        with self._lock:
+            self.ckpt_skipped += 1
+            self.last_event = "async checkpoint skipped (writer busy)"
+        if self._parent is not None:
+            self._parent.record_ckpt_skip()
 
     def record_steps(self, nsteps, skipped, grad_norm=None):
         with self._lock:
@@ -113,6 +125,7 @@ class TrainingHealth(object):
             return {"steps": self.steps, "skipped": self.skipped,
                     "divergences": self.divergences,
                     "rollbacks": self.rollbacks,
+                    "ckpt_skipped": self.ckpt_skipped,
                     "last_grad_norm": self.last_grad_norm,
                     "last_loss": self.last_loss,
                     "last_event": self.last_event}
@@ -123,6 +136,7 @@ class TrainingHealth(object):
             self.skipped = 0
             self.divergences = 0
             self.rollbacks = 0
+            self.ckpt_skipped = 0
             self.last_grad_norm = None
             self.last_loss = None
             self.last_event = None

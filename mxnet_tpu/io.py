@@ -455,10 +455,18 @@ class SuperBatchIter(DataIter):
     ``last_group_handle='discard'``.
     """
 
-    def __init__(self, base, k, prefetch=True, queue_depth=2,
+    def __init__(self, base, k, prefetch=True, queue_depth=None,
                  last_group_handle="partial", retry_policy=None,
                  data_health=None):
         super().__init__(getattr(base, "batch_size", 0))
+        if queue_depth is None:
+            # keep the producer ahead of fit's dispatch pipeline
+            # (docs/perf.md "Host off the critical path"): a depth-D
+            # deferred readback holds D+1 dispatches' inputs in flight, so
+            # fewer than D+1 queue slots would stall the consumer exactly
+            # when the pipeline is hiding host latency
+            from . import engine as _engine
+            queue_depth = max(2, _engine.dispatch_pipeline() + 1)
         if k < 1:
             raise MXNetError("superbatch: k must be >= 1, got %r" % (k,))
         if last_group_handle not in ("partial", "discard"):

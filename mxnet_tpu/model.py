@@ -4,11 +4,14 @@ checkpointing, FeedForward :387).
 """
 from __future__ import annotations
 
+import atexit
 import glob
 import hashlib
 import json
 import logging
 import os
+import threading
+import time
 from collections import namedtuple
 
 import numpy as np
@@ -264,6 +267,182 @@ class CheckpointState(object):
             data.reshape(self.rng["shape"])))
 
 
+class AsyncCheckpointWriter(object):
+    """Background checkpoint writer: the training loop pays only for a
+    cheap on-device snapshot (array copies decoupled from the donated
+    fused state); the D2H transfer, serialization, sha256, finite-params
+    known-good verification and the atomic rename/manifest/latest sequence
+    all run on ONE writer thread (docs/robustness.md "Asynchronous
+    checkpointing"; docs/perf.md "Host off the critical path").
+
+    At most one save is in flight. A save submitted while another is still
+    writing is SHED and counted (``skipped``; mirrored into the run's
+    :class:`~mxnet_tpu.guard.TrainingHealth` via ``record_ckpt_skip``) —
+    back-pressure must drop cadence, not queue an unbounded convoy of
+    full-model snapshots behind a slow disk.
+
+    Crash-consistency invariants are unchanged from the sync path: the
+    writer runs the exact same atomic write sequence (params, states,
+    manifest, then ``latest``), so ``latest`` never references a partial
+    file and a crash mid-async-save leaves the previous checkpoint
+    generation valid. ``fit`` blocks on :meth:`drain` only at epoch ends,
+    divergence rollback and teardown; :meth:`close` is also registered
+    with ``atexit`` so interpreter exit waits for the in-flight save.
+
+    Fault sites (:mod:`mxnet_tpu.faults`): ``ckpt.async_write`` fires on
+    the writer thread before a job's first byte (raise/transient => the
+    save is dropped and counted in ``errors``); ``ckpt.async_die`` ==
+    ``"die"`` kills the writer thread mid-job — the next submit or drain
+    reaps the corpse (counts an error) and a later submit restarts the
+    thread.
+    """
+
+    def __init__(self, logger=None, health=None):
+        self.logger = logger or logging
+        #: TrainingHealth-like sink for back-pressure skips (or None)
+        self.health = health
+        self.submitted = 0
+        self.written = 0
+        self.skipped = 0
+        self.errors = 0
+        self.restarts = 0
+        self._cond = threading.Condition()
+        self._job = None          # pending (not yet started) job closure
+        self._busy = False        # a job is being written right now
+        self._closed = False
+        self._thread = None
+        atexit.register(self.close)
+
+    # -- state inspection ----------------------------------------------
+    def _reap_dead_locked(self):
+        """Detect a writer thread that died mid-job (``ckpt.async_die`` or
+        a hard crash): clear the wedged in-flight state so ``drain`` cannot
+        hang and ``submit`` can restart the thread. The lost job's temp
+        files are orphans; manifest/latest were never touched."""
+        if ((self._busy or self._job is not None)
+                and self._thread is not None
+                and not self._thread.is_alive()):
+            # the corpse reference stays: the next submit sees a dead
+            # thread and counts the restart
+            self._busy = False
+            self._job = None
+            self.errors += 1
+            self.logger.warning(
+                "AsyncCheckpointWriter: writer thread died mid-save; the "
+                "in-flight checkpoint is lost (previous generation remains "
+                "valid)")
+            return True
+        return False
+
+    def busy(self):
+        """True when a save is queued or being written (a submit now would
+        be shed)."""
+        with self._cond:
+            self._reap_dead_locked()
+            return self._busy or self._job is not None
+
+    # -- submission ----------------------------------------------------
+    def note_skip(self, tag=None):
+        """Record a shed save (back-pressure): counted here and in the
+        attached health sink."""
+        with self._cond:
+            self.skipped += 1
+        if self.health is not None:
+            rec = getattr(self.health, "record_ckpt_skip", None)
+            if rec is not None:
+                rec()
+        self.logger.warning(
+            "async checkpoint%s skipped: previous save still in flight "
+            "(slow disk? lengthen checkpoint_every_n_batches)",
+            (" %s" % tag) if tag else "")
+
+    def submit(self, fn):
+        """Queue ``fn`` (the full write job) for the writer thread.
+        Returns False — without running anything — when a save is already
+        in flight (the caller should :meth:`note_skip`)."""
+        with self._cond:
+            if self._closed:
+                raise MXNetError("AsyncCheckpointWriter is closed")
+            self._reap_dead_locked()
+            if self._busy or self._job is not None:
+                return False
+            self.submitted += 1
+            self._job = fn
+            if self._thread is None or not self._thread.is_alive():
+                if self._thread is not None:
+                    self.restarts += 1
+                self._thread = threading.Thread(
+                    target=self._run, name="mxtpu-async-ckpt", daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+            return True
+
+    # -- writer thread --------------------------------------------------
+    def _run(self):
+        from . import faults as _faults
+        while True:
+            with self._cond:
+                while self._job is None and not self._closed:
+                    self._cond.wait()
+                if self._job is None:
+                    return  # closed and drained
+                fn = self._job
+                self._job = None
+                self._busy = True
+            if _faults.fire("ckpt.async_die") == "die":
+                return  # simulated abrupt death: stays wedged until reaped
+            try:
+                _faults.fire("ckpt.async_write")
+                fn()
+                with self._cond:
+                    self.written += 1
+            except BaseException as exc:
+                with self._cond:
+                    self.errors += 1
+                self.logger.error(
+                    "async checkpoint save failed (%s: %s); the previous "
+                    "checkpoint generation remains the newest valid one",
+                    type(exc).__name__, exc)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    # -- barriers --------------------------------------------------------
+    def drain(self, timeout=None):
+        """Block until no save is in flight. True when the writer emptied
+        cleanly; False on timeout or when the writer died mid-save (that
+        job is lost; the previous checkpoint generation is intact)."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            while self._busy or self._job is not None:
+                if self._reap_dead_locked():
+                    return False
+                wait = 0.05  # poll: a dying thread never notifies
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._cond.wait(timeout=wait)
+            return True
+
+    def close(self):
+        """Drain and stop the writer thread (idempotent; also the atexit
+        hook, so interpreter exit blocks until the in-flight save lands)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self.drain()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+
+
 class CheckpointManager(object):
     """Atomic, checksummed, self-validating training checkpoints.
 
@@ -279,11 +458,23 @@ class CheckpointManager(object):
     corrupt — the recovery contract the fault-injection suite pins down.
     """
 
-    def __init__(self, prefix, keep=3, logger=None, save_rng=True):
+    def __init__(self, prefix, keep=3, logger=None, save_rng=True,
+                 async_writer=None):
         self.prefix = os.fspath(prefix)
         self.keep = max(1, int(keep))
         self.logger = logger or logging
         self.save_rng = save_rng
+        #: attach a :class:`AsyncCheckpointWriter` to move the D2H +
+        #: serialize + hash + fsync work off the caller's thread; ``save``
+        #: then only snapshots (device copies) and submits
+        self.async_writer = async_writer
+        #: the writer a finished ``fit`` closed and detached — counters
+        #: (written/skipped/errors) stay readable here after the run
+        self.last_async_writer = None
+        #: cumulative seconds ``save`` spent on the CALLER's thread (full
+        #: write when sync; snapshot+submit when async) — bench.py's
+        #: host-overhead mode reads this for host_stall_frac
+        self.save_time = 0.0
         d = os.path.dirname(os.path.abspath(self.prefix))
         if d and not os.path.isdir(d):
             os.makedirs(d, exist_ok=True)
@@ -306,13 +497,114 @@ class CheckpointManager(object):
 
         ``batches_done`` is the number of completed batches within
         ``epoch`` (0 = clean epoch start). Returns the tag written.
-        """
-        tag = self._tag(epoch, batches_done)
-        files = {}
 
+        With an :class:`AsyncCheckpointWriter` attached, this thread only
+        takes the cheap on-device snapshot and submits; the write job runs
+        in the background and ``save`` returns the tag it WILL write —
+        call :meth:`drain` before trusting it on disk. Returns None when
+        the save was shed under back-pressure (a previous save still in
+        flight).
+        """
+        t0 = time.perf_counter()
+        try:
+            if self.async_writer is not None:
+                tag = self._tag(epoch, batches_done)
+                if self.async_writer.busy():
+                    # shed BEFORE snapshotting: the check is the cheap part
+                    self.async_writer.note_skip(tag)
+                    return None
+                job = self._snapshot(module, epoch, batches_done,
+                                     metric=metric, decouple=True)
+                if job["needs_module"] is not None:
+                    # no decoupled optimizer snapshot for this module kind:
+                    # write synchronously (correctness over latency)
+                    return self._write_job(job)
+                if self.async_writer.submit(lambda: self._write_job(job)):
+                    return tag
+                self.async_writer.note_skip(tag)
+                return None
+            return self._write_job(
+                self._snapshot(module, epoch, batches_done, metric=metric))
+        finally:
+            self.save_time += time.perf_counter() - t0
+
+    def drain(self):
+        """Block until any in-flight async save has landed (no-op without
+        an async writer). Returns False when the in-flight save was lost
+        (writer died) — the previous checkpoint generation is intact."""
+        if self.async_writer is not None:
+            return self.async_writer.drain()
+        return True
+
+    def _snapshot(self, module, epoch, batches_done, metric=None,
+                  decouple=False):
+        """Capture everything a checkpoint needs WITHOUT host-side heavy
+        lifting: device-side array copies, the host training cursor, RNG
+        key and metric sums. ``decouple=True`` (async mode) additionally
+        copies every param/aux array so later in-place training updates
+        (the imperative executor path mutates arrays) cannot race the
+        writer thread; copies are device-to-device and asynchronous."""
+        tag = self._tag(epoch, batches_done)
         arg_params, aux_params = module.get_params()
+        arg_params = dict(arg_params or {})
+        aux_params = dict(aux_params or {})
+        if decouple:
+            def cp(v):
+                return v.copy() if hasattr(v, "copy") else v
+            arg_params = {n: cp(v) for n, v in arg_params.items()}
+            aux_params = {n: cp(v) for n, v in aux_params.items()}
+        job = {"tag": tag, "epoch": int(epoch),
+               "batches_done": int(batches_done),
+               "arg_params": arg_params, "aux_params": aux_params,
+               "states_fn": None, "needs_module": None, "symbol_json": None}
+        if getattr(module, "optimizer_initialized", False):
+            states_fn = None
+            if decouple:
+                # the device-side state replica exists only to decouple the
+                # writer thread from concurrent in-place updates; a sync
+                # save writes inline before training resumes, so it keeps
+                # the copy-free module.save_optimizer_states path
+                snap = getattr(module, "_snapshot_opt_states", None)
+                states_fn = snap() if snap is not None else None
+            if states_fn is not None:
+                job["states_fn"] = states_fn
+            else:
+                job["needs_module"] = module
+        if getattr(module, "symbol", None) is not None:
+            sym_f = "%s-symbol.json" % self.prefix
+            if not os.path.exists(sym_f):
+                job["symbol_json"] = module.symbol.tojson().encode()
+        opt = getattr(module, "_optimizer", None)
+        job["num_update"] = int(getattr(opt, "num_update", 0) or 0)
+        # the device step counter can TRAIL num_update when the guard
+        # skipped non-finite steps (a skip is a full no-op, the host lr
+        # clock still advances); record it so resume/rollback restores the
+        # exact noise/Adam-t clock instead of re-deriving it from
+        # num_update (read from the module's host-side step clock cache —
+        # never a device sync)
+        fused_step = getattr(module, "_fused_step_count", None)
+        job["fused_step"] = fused_step() if callable(fused_step) else None
+        job["rng"] = None
+        if self.save_rng:
+            import jax
+            from . import random as _random
+            kd = np.asarray(jax.random.key_data(_random.get_state()))
+            job["rng"] = {"dtype": str(kd.dtype), "shape": list(kd.shape),
+                          "data": kd.reshape(-1).tolist()}
+        job["metric"] = self._metric_state(metric)
+        return job
+
+    def _write_job(self, job):
+        """The host-heavy half of a save: D2H, serialization, sha256,
+        finite-params verification and the atomic write sequence (params,
+        states, symbol-on-first-save, manifest, latest — the order the
+        fault-injection suite pins). Runs inline for sync saves and on the
+        writer thread for async ones; byte-identical output either way."""
+        tag = job["tag"]
+        files = {}
         params_f = self._file(tag, "params")
-        params_bytes = _param_save_bytes(arg_params or {}, aux_params or {})
+        params_bytes = _param_save_bytes(job["arg_params"],
+                                         job["aux_params"])
         atomic_write_bytes(params_f, params_bytes)
         # hash the INTENDED payload, not a re-read of the file: a write
         # torn between publish and durability then shows up as a
@@ -323,33 +615,33 @@ class CheckpointManager(object):
             "sha256": hashlib.sha256(params_bytes).hexdigest(),
         }
 
-        if getattr(module, "optimizer_initialized", False):
+        states_bytes = None
+        if job["states_fn"] is not None:
             states_f = self._file(tag, "states")
-            states_bytes = module.save_optimizer_states(states_f)
+            states_bytes = job["states_fn"]()
+            atomic_write_bytes(states_f, states_bytes)
+        elif job["needs_module"] is not None:
+            states_f = self._file(tag, "states")
+            states_bytes = job["needs_module"].save_optimizer_states(states_f)
             if not isinstance(states_bytes, (bytes, bytearray)):
                 # module whose save doesn't return the payload: re-read
                 # (loses torn-write detection for this file only)
                 with open(states_f, "rb") as f:
                     states_bytes = f.read()
+        if states_bytes is not None:
             files["states"] = {
                 "name": os.path.basename(states_f),
                 "size": len(states_bytes),
                 "sha256": hashlib.sha256(bytes(states_bytes)).hexdigest(),
             }
 
-        if getattr(module, "symbol", None) is not None:
+        if job["symbol_json"] is not None:
             sym_f = "%s-symbol.json" % self.prefix
             if not os.path.exists(sym_f):
-                atomic_write_bytes(sym_f, module.symbol.tojson().encode())
+                atomic_write_bytes(sym_f, job["symbol_json"])
 
-        opt = getattr(module, "_optimizer", None)
-        # the device step counter can TRAIL num_update when the guard
-        # skipped non-finite steps (a skip is a full no-op, the host lr
-        # clock still advances); record it so resume/rollback restores the
-        # exact noise/Adam-t clock instead of re-deriving it from num_update
-        fused_step = getattr(module, "_fused_step_count", None)
-        fused_step = fused_step() if callable(fused_step) else None
-        known_good = self._params_finite(arg_params, aux_params)
+        known_good = self._params_finite(job["arg_params"],
+                                         job["aux_params"])
         from . import faults as _faults
         if _faults.fire_flag("guard.param_nan"):
             known_good = False
@@ -361,30 +653,24 @@ class CheckpointManager(object):
         manifest = {
             "version": CKPT_VERSION,
             "tag": tag,
-            "epoch": int(epoch),
-            "batches_done": int(batches_done),
-            "num_update": int(getattr(opt, "num_update", 0) or 0),
+            "epoch": job["epoch"],
+            "batches_done": job["batches_done"],
+            "num_update": job["num_update"],
             "known_good": bool(known_good),
             "files": files,
         }
-        if fused_step is not None:
-            manifest["fused_step"] = int(fused_step)
-        if self.save_rng:
-            import jax
-            from . import random as _random
-            kd = np.asarray(jax.random.key_data(_random.get_state()))
-            manifest["rng"] = {"dtype": str(kd.dtype),
-                               "shape": list(kd.shape),
-                               "data": kd.reshape(-1).tolist()}
-        ms = self._metric_state(metric)
-        if ms is not None:
-            manifest["metric"] = ms
+        if job["fused_step"] is not None:
+            manifest["fused_step"] = int(job["fused_step"])
+        if job["rng"] is not None:
+            manifest["rng"] = job["rng"]
+        if job["metric"] is not None:
+            manifest["metric"] = job["metric"]
         atomic_write_bytes(self._file(tag, "manifest.json"),
                            json.dumps(manifest, indent=1).encode())
         atomic_write_bytes(self.latest_path, tag.encode())
         self._prune()
         self.logger.info("Saved checkpoint %s (epoch %d, %d batches done)",
-                         tag, epoch, batches_done)
+                         tag, job["epoch"], job["batches_done"])
         return tag
 
     @staticmethod

@@ -6,11 +6,11 @@ from __future__ import annotations
 import logging
 import os
 import time
-from collections import namedtuple
+from collections import deque, namedtuple
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, env_bool
 from .. import metric as _metric
 from .. import ndarray as nd
 from ..ndarray import NDArray
@@ -23,6 +23,71 @@ def _as_list(obj):
     if isinstance(obj, list):
         return obj
     return [obj]
+
+
+class _DispatchPipeline(object):
+    """Deferred-readback window for K-step fused dispatches (docs/perf.md
+    "Host off the critical path").
+
+    ``run_steps`` returns a device-resident packed metric/sentinel array —
+    a future; the ONLY host block in the steady-state train loop is its
+    ``np.asarray`` readback. With depth D, ``fit`` enqueues dispatch N+D
+    before fetching dispatch N's array, so the device always has the next
+    scan queued while the host blocks — Speedometer, batch callbacks and
+    the TrainingGuard consume D-dispatch-lagged sums in strict dispatch
+    order (FIFO: the metric/guard fold sequence is bitwise identical to
+    eager, only later in wall-clock). Depth 0 is eager mode.
+
+    ``host_stall`` accumulates the seconds actually spent blocked in
+    readbacks — the Speedometer pipeline suffix and bench.py's
+    ``host_stall_frac`` read it.
+    """
+
+    __slots__ = ("depth", "_pending", "dispatches", "retired", "host_stall")
+
+    def __init__(self, depth):
+        self.depth = max(0, int(depth))
+        self._pending = deque()
+        self.dispatches = 0
+        self.retired = 0
+        self.host_stall = 0.0
+
+    def __len__(self):
+        return len(self._pending)
+
+    def push(self, sums, nsteps, nbatch):
+        """Enqueue one dispatch's device-resident sums; returns the list of
+        ``(sums, nsteps, nbatch)`` entries that fell out of the window
+        (fetched, ready to fold into metric/guard)."""
+        self.dispatches += 1
+        self._pending.append((sums, nsteps, nbatch))
+        out = []
+        while len(self._pending) > self.depth:
+            out.append(self._fetch_one())
+        return out
+
+    def drain(self):
+        """Fetch everything still in flight (checkpoint sealing, epoch
+        ends, per-step fallbacks: consumers need ALL sentinels covering the
+        current state before acting on it)."""
+        out = []
+        while self._pending:
+            out.append(self._fetch_one())
+        return out
+
+    def discard(self):
+        """Divergence rollback: pending dispatches cover post-divergence
+        state — their sums must never reach the metric or the guard. The
+        device work is abandoned, not awaited."""
+        self._pending.clear()
+
+    def _fetch_one(self):
+        sums, nsteps, nbatch = self._pending.popleft()
+        t0 = time.perf_counter()
+        sums.fetch()
+        self.host_stall += time.perf_counter() - t0
+        self.retired += 1
+        return sums, nsteps, nbatch
 
 
 class BaseModule(object):
@@ -124,7 +189,8 @@ class BaseModule(object):
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, steps_per_dispatch=None, resume=None,
             checkpoint_prefix=None, checkpoint_every_n_batches=None,
-            checkpoint_keep=3, guard=None):
+            checkpoint_keep=3, checkpoint_async=None, guard=None,
+            dispatch_pipeline=None):
         """The training loop (ref: base_module.py:368-519).
 
         ``steps_per_dispatch=k`` (default: ``engine.bulk_size()``, normally
@@ -146,6 +212,26 @@ class BaseModule(object):
         the train iterator past the already-trained batches, so a killed
         run re-launched with the same script continues bit-for-bit. The
         last ``checkpoint_keep`` checkpoints are retained.
+        ``checkpoint_async=True`` (env default ``MXTPU_ASYNC_CKPT``) moves
+        the D2H + serialize + hash + fsync work to a background writer
+        thread (docs/robustness.md "Asynchronous checkpointing"): the loop
+        pays only for an on-device snapshot, blocks on the writer only at
+        epoch ends / rollback / teardown, and sheds (counts) a cadence
+        save whose predecessor is still in flight. Checkpoint bytes and
+        crash-consistency invariants are identical to the sync path.
+
+        Host off the critical path (docs/perf.md): under
+        ``steps_per_dispatch=k`` the dispatch loop is PIPELINED —
+        ``dispatch_pipeline=d`` (env default ``MXTPU_DISPATCH_PIPELINE``,
+        1) defers each dispatch's packed metric/sentinel readback until
+        ``d`` further dispatches are enqueued, so the device never idles
+        on the host between scans. Metric, Speedometer, batch callbacks
+        and the guard consume d-dispatch-lagged sums in strict dispatch
+        order (bitwise-identical fold sequence; divergence detection gains
+        a bounded staleness of d dispatches); checkpoint sealing always
+        drains the pipeline first, so a diverged state can never be sealed
+        known-good. ``dispatch_pipeline=0`` — and any per-step
+        configuration (k=1, monitors, epoch tails) — is the eager mode.
 
         Numerical guardrails (docs/robustness.md "Numerical guardrails"):
         ``guard=True`` (or a configured
@@ -167,9 +253,14 @@ class BaseModule(object):
         resume_state = None
         if checkpoint_prefix is not None:
             from ..model import CheckpointManager
-            ckpt_mgr = CheckpointManager(checkpoint_prefix,
-                                         keep=checkpoint_keep,
-                                         logger=self.logger)
+            if isinstance(checkpoint_prefix, CheckpointManager):
+                # callers (bench.py host-overhead mode, tests) may pass a
+                # preconfigured manager to read its counters afterwards
+                ckpt_mgr = checkpoint_prefix
+            else:
+                ckpt_mgr = CheckpointManager(checkpoint_prefix,
+                                             keep=checkpoint_keep,
+                                             logger=self.logger)
         if resume in ("auto", True):
             if ckpt_mgr is None:
                 raise MXNetError("fit(resume=%r) requires checkpoint_prefix"
@@ -211,8 +302,7 @@ class BaseModule(object):
 
         # numerical guardrails (docs/robustness.md "Numerical guardrails")
         from ..guard import TrainingGuard, _DivergenceRollback
-        if guard is None and os.environ.get("MXTPU_GUARD", "") \
-                .strip().lower() not in ("", "0", "false", "off", "no"):
+        if guard is None and env_bool("MXTPU_GUARD"):
             guard = True
         if guard in (None, False):
             guard = None
@@ -233,8 +323,25 @@ class BaseModule(object):
                     "guard: no checkpoint_prefix — divergence cannot roll "
                     "back and will raise TrainingDivergedError")
 
+        # asynchronous checkpointing (docs/robustness.md): attach a
+        # background writer so cadence saves cost the loop only a device
+        # snapshot; created here (after guard resolution) so back-pressure
+        # skips count into THIS run's health object
+        writer_owned = False
+        if ckpt_mgr is not None:
+            if checkpoint_async is None:
+                checkpoint_async = env_bool("MXTPU_ASYNC_CKPT")
+            if checkpoint_async and ckpt_mgr.async_writer is None:
+                from ..model import AsyncCheckpointWriter
+                from .. import guard as _guard_mod
+                ckpt_mgr.async_writer = AsyncCheckpointWriter(
+                    logger=self.logger,
+                    health=(guard.health if guard is not None
+                            else _guard_mod.TRAINING_HEALTH))
+                writer_owned = True
+
         fused_step = getattr(self, "_try_fused_fit_step", None)
-        fused_steps = getattr(self, "_try_fused_fit_steps", None)
+        fused_dispatch = getattr(self, "_dispatch_fused_steps", None)
         k = (steps_per_dispatch if steps_per_dispatch is not None
              else _engine.bulk_size())
         k = max(1, int(k))
@@ -242,7 +349,7 @@ class BaseModule(object):
             reason = None
             if monitor is not None:
                 reason = "a monitor needs per-step executor access"
-            elif fused_steps is None:
+            elif fused_dispatch is None:
                 reason = "this module has no fused multi-step path"
             elif not hasattr(train_data, "superbatch"):
                 reason = "train_data is not a DataIter (no superbatch mode)"
@@ -270,7 +377,47 @@ class BaseModule(object):
                     "steps_per_dispatch=%d unavailable (%s); training "
                     "with 1", k, reason)
                 k = 1
-        train_iter = train_data.superbatch(k) if k > 1 else train_data
+
+        # pipelined dispatch (docs/perf.md "Host off the critical path"):
+        # eager mode is auto-selected for per-step configurations — k=1
+        # trains through per-step host metrics, whose output readback is
+        # the sync point the pipeline would otherwise defer
+        pl_depth = (dispatch_pipeline if dispatch_pipeline is not None
+                    else _engine.dispatch_pipeline())
+        pl_depth = max(0, int(pl_depth))
+        if k <= 1 or fused_dispatch is None:
+            pl_depth = 0
+        pipeline = _DispatchPipeline(pl_depth)
+        train_iter = (train_data.superbatch(k,
+                                            queue_depth=max(2, pl_depth + 1))
+                      if k > 1 else train_data)
+
+        note_retired = getattr(self, "_note_dispatch_retired", None)
+
+        def _consume(entries, epoch):
+            """Retire dispatches in dispatch order: fold each one's sums
+            into the metric and the guard, then fire ITS batch callback
+            before folding the next — so every callback observes the
+            metric exactly as the eager mode would have at the same
+            nbatch (the fold+fire sequence is what the bitwise
+            pipelined-vs-eager parity contract pins)."""
+            for sums, nsteps, nb in entries:
+                _metric.update_from_device_sums(eval_metric, sums)
+                if guard is not None:
+                    guard.on_dispatch(loss_sum=sums.loss_sum,
+                                      nsamp=sums.num_samples,
+                                      skipped=sums.skipped,
+                                      grad_norm=sums.last_grad_norm,
+                                      nsteps=nsteps)
+                if note_retired is not None:
+                    note_retired(sums, nsteps)
+                if batch_end_callback is not None:
+                    cb_params = BatchEndParam(
+                        epoch=epoch, nbatch=nb, eval_metric=eval_metric,
+                        locals={"guard": guard, "pipeline": pipeline,
+                                "eval_metric": eval_metric, "self": self})
+                    for callback in _as_list(batch_end_callback):
+                        callback(cb_params)
 
         try:
             epoch = begin_epoch
@@ -295,6 +442,7 @@ class BaseModule(object):
                 try:
                     for data_batch in train_iter:
                         tail_batches = None
+                        stepped_eager = False
                         if resume_skip > 0:
                             n = getattr(data_batch, "num_steps", 1)
                             if n <= resume_skip:
@@ -310,18 +458,26 @@ class BaseModule(object):
                         if monitor is not None:
                             monitor.tic()
                         # fast path: K fused steps in one donated lax.scan
-                        # dispatch, metrics accumulated on device, read back
-                        # once
+                        # dispatch; the packed metric/sentinel readback is
+                        # DEFERRED through the pipeline so dispatch N+1 is
+                        # enqueued before dispatch N's np.asarray
+                        sums = None
                         if (tail_batches is None and k > 1
                                 and getattr(data_batch, "num_steps", 0) == k
-                                and fused_steps(data_batch, eval_metric,
-                                                guard)):
+                                and fused_dispatch is not None):
+                            sums = fused_dispatch(data_batch, guard)
+                        if sums is not None:
                             nbatch += data_batch.num_steps
                             since_ckpt += data_batch.num_steps
+                            _consume(pipeline.push(
+                                sums, data_batch.num_steps, nbatch), epoch)
                         else:
                             # per-step path: the general executor loop, also
                             # the epoch tail (num_steps < k) without a
-                            # K'-recompile
+                            # K'-recompile. Eager by contract — per-step
+                            # host metrics must fold in dispatch order, so
+                            # everything still in flight retires first.
+                            _consume(pipeline.drain(), epoch)
                             if tail_batches is None:
                                 tail_batches = (
                                     data_batch.unstack()
@@ -347,6 +503,7 @@ class BaseModule(object):
                                         or not guard.last_step_skipped:
                                     self.update_metric(eval_metric,
                                                        batch.label)
+                            stepped_eager = True
                         if monitor is not None:
                             monitor.toc_print()
                         if guard is not None and guard.diverged:
@@ -356,29 +513,52 @@ class BaseModule(object):
                             raise _DivergenceRollback()
                         if (ckpt_mgr is not None
                                 and checkpoint_every_n_batches
-                                and since_ckpt >= checkpoint_every_n_batches
-                                and (guard is None
-                                     or guard.ok_to_checkpoint())):
-                            # a mid-spike state is suspect: deferring the
-                            # save keeps the newest known-good checkpoint
-                            # PRE-spike, so a rollback escapes the
-                            # divergence instead of re-entering it
-                            ckpt_mgr.save(self, epoch, nbatch + 1,
-                                          metric=eval_metric)
-                            since_ckpt = 0
-                        self._check_worker_health(ckpt_mgr, eval_metric,
-                                                  epoch, nbatch)
-                        if batch_end_callback is not None:
+                                and since_ckpt >= checkpoint_every_n_batches):
+                            # checkpoint sealing needs EVERY sentinel
+                            # covering the state it is about to seal: drain
+                            # the pipeline, re-check divergence, then gate
+                            # on the (now fully informed) guard
+                            _consume(pipeline.drain(), epoch)
+                            if guard is not None and guard.diverged:
+                                raise _DivergenceRollback()
+                            if guard is None or guard.ok_to_checkpoint():
+                                # a mid-spike state is suspect: deferring the
+                                # save keeps the newest known-good checkpoint
+                                # PRE-spike, so a rollback escapes the
+                                # divergence instead of re-entering it
+                                ckpt_mgr.save(self, epoch, nbatch + 1,
+                                              metric=eval_metric)
+                                since_ckpt = 0
+                        self._check_worker_health(
+                            ckpt_mgr, eval_metric, epoch, nbatch,
+                            drain_pipeline=lambda e=epoch: _consume(
+                                pipeline.drain(), e),
+                            guard=guard)
+                        if stepped_eager and batch_end_callback is not None:
+                            # eagerly-trained batches (per-step path): one
+                            # callback at the current nbatch, exactly as
+                            # before
                             batch_end_params = BatchEndParam(
                                 epoch=epoch, nbatch=nbatch,
                                 eval_metric=eval_metric, locals=locals())
                             for callback in _as_list(batch_end_callback):
                                 callback(batch_end_params)
+                    # epoch end: everything still in flight retires (folds
+                    # + fires its callbacks) before the epoch is sealed
+                    # (train metric logged, epoch-end checkpoint written) —
+                    # and a divergence surfacing in those last sentinels
+                    # still rolls back, never seals
+                    _consume(pipeline.drain(), epoch)
+                    if guard is not None and guard.diverged:
+                        raise _DivergenceRollback()
                 except _DivergenceRollback:
                     # divergence: restore the newest known-good checkpoint,
                     # rewind the trainer clock, reduce lr, and re-enter the
                     # epoch loop at the restored cursor (the iterator is
-                    # reset and re-fast-forwarded like a resume)
+                    # reset and re-fast-forwarded like a resume). Dispatches
+                    # still in the pipeline cover post-divergence state:
+                    # their sums must never reach the metric or the guard
+                    pipeline.discard()
                     resume_state = self._guard_rollback(guard, ckpt_mgr)
                     epoch = resume_state.epoch
                     train_iter.reset()
@@ -417,8 +597,14 @@ class BaseModule(object):
                                              or guard.ok_to_checkpoint()):
                     # epoch boundary checkpoint: cursor points at the clean
                     # start of the next epoch (deferred while the loss
-                    # watcher is mid-spike, same as cadence saves)
+                    # watcher is mid-spike, same as cadence saves). The
+                    # epoch end is a BARRIER for async saves: an in-flight
+                    # cadence save lands first (so the epoch-end save is
+                    # never shed by back-pressure), then fit blocks until
+                    # the epoch's state is durably on disk
+                    ckpt_mgr.drain()
                     ckpt_mgr.save(self, epoch + 1, 0)
+                    ckpt_mgr.drain()
                 if train_iter is train_data or epoch < num_epoch - 1:
                     train_iter.reset()
                 else:
@@ -430,6 +616,20 @@ class BaseModule(object):
                     train_data.reset()
                 epoch += 1
         finally:
+            if ckpt_mgr is not None and ckpt_mgr.async_writer is not None:
+                # teardown barrier: the in-flight save lands (or is reaped)
+                # before fit returns; a writer fit created is shut down AND
+                # detached so the manager stays usable (a later fit makes a
+                # fresh writer, a manual save falls back to sync) — its
+                # counters stay readable via last_async_writer. A
+                # caller-attached writer is only drained.
+                if writer_owned:
+                    w = ckpt_mgr.async_writer
+                    w.close()
+                    ckpt_mgr.async_writer = None
+                    ckpt_mgr.last_async_writer = w
+                else:
+                    ckpt_mgr.async_writer.drain()
             if train_iter is not train_data:
                 # exception paths included: never leave a producer thread
                 # consuming the user's iterator (close() is idempotent)
@@ -456,6 +656,9 @@ class BaseModule(object):
                 "training diverged (%s) and fit() has no checkpoint_prefix "
                 "to roll back to — configure checkpoints or lower the lr"
                 % (guard.diverged_reason,), health=guard.health)
+        # async saves: the rollback target search must see the newest save
+        # fully on disk (manifest + latest), not race a half-written one
+        ckpt_mgr.drain()
         st = ckpt_mgr.load_latest()
         if st is None:
             raise TrainingDivergedError(
@@ -516,7 +719,8 @@ class BaseModule(object):
         eval_metric.sum_metric = s
         eval_metric.num_inst = n
 
-    def _check_worker_health(self, ckpt_mgr, eval_metric, epoch, nbatch):
+    def _check_worker_health(self, ckpt_mgr, eval_metric, epoch, nbatch,
+                             drain_pipeline=None, guard=None):
         """Dist kvstore degradation policy: feed ``num_dead_node`` into
         warn -> emergency checkpoint -> ``WorkerLostError`` escalation
         (KVStore.check_health throttles the underlying heartbeat scan).
@@ -527,7 +731,24 @@ class BaseModule(object):
         on_degraded = None
         if ckpt_mgr is not None:
             def on_degraded():
+                # checkpoint sealing needs every in-flight dispatch retired
+                # first (metric folds + guard sentinels + step mirror must
+                # cover the state being saved) — same invariant as the
+                # cadence/epoch-end sites, and a diverged state still must
+                # never seal known-good
+                if drain_pipeline is not None:
+                    drain_pipeline()
+                if guard is not None and not guard.ok_to_checkpoint():
+                    self.logger.warning(
+                        "worker-loss emergency checkpoint skipped: the "
+                        "guard reports the current state unsafe to seal")
+                    return
+                # emergency checkpoint must never be shed by async
+                # back-pressure (a cadence save in flight) and must be
+                # durable BEFORE check_health escalates to WorkerLostError
+                ckpt_mgr.drain()
                 ckpt_mgr.save(self, epoch, nbatch + 1, metric=eval_metric)
+                ckpt_mgr.drain()
         kv.check_health(on_degraded=on_degraded)
 
     # -- symbol / params accessors -------------------------------------

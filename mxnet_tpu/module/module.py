@@ -82,6 +82,10 @@ class Module(BaseModule):
         # checkpoint resume: the update-count the fused step clock (and lr
         # schedule) continues from (set via _restore_trainer_clock)
         self._resume_step = 0
+        # host-side mirror of the fused device step counter, advanced
+        # arithmetically per dispatch so progress queries (checkpoint
+        # manifests, _fused_step_count) never sync the device
+        self._fused_host_step = 0
 
     # -- checkpointing (ref: module.py:97-156, :674-704) ----------------
     @staticmethod
@@ -397,14 +401,19 @@ class Module(BaseModule):
             scale(upd_opt)
 
     def _fused_step_count(self):
-        """The fused device step counter, for checkpoint manifests: trails
+        """The fused step counter, for checkpoint manifests: trails
         ``num_update`` by the number of guard-skipped steps, and is the
         clock the dropout/SGLD noise streams and Adam's t actually follow.
-        None when no fused state is live."""
+        None when no fused state is live.
+
+        Reads the HOST-side mirror (advanced arithmetically per dispatch;
+        guarded dispatches advance it at sentinel retirement, which always
+        precedes a checkpoint snapshot) — never ``np.asarray`` on the
+        device counter, so progress queries cost no device sync and cannot
+        stall the dispatch pipeline."""
         if self._fused_state is None:
             return None
-        import numpy as np
-        return int(np.asarray(self._fused_state["step"]))
+        return int(self._fused_host_step)
 
     def _restore_trainer_clock(self, num_update, fused_step=None):
         """Resume hook: continue the optimizer's update clock (lr schedule,
@@ -433,6 +442,7 @@ class Module(BaseModule):
             import jax.numpy as jnp
             self._fused_state["step"] = jnp.full((), self._resume_step,
                                                  jnp.int32)
+            self._fused_host_step = self._resume_step
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
@@ -513,7 +523,7 @@ class Module(BaseModule):
             return False
 
     def _can_bulk_dispatch(self):
-        """fit()'s precheck half of :meth:`_try_fused_fit_steps`: called
+        """fit()'s precheck half of :meth:`_dispatch_fused_steps`: called
         after init_optimizer so steps_per_dispatch>1 warns and skips the
         superbatch wrapper up front instead of silently paying K-batch
         stacking for dispatches the per-step path ends up training."""
@@ -558,12 +568,13 @@ class Module(BaseModule):
                for n in self._fused.aux_names}
         if prev is not None:
             opt_state = prev["opt"]
-            step = prev["step"]
+            step = prev["step"]  # host mirror already tracks it
         else:
             opt_state = self._fused_opt_state(params)
             # a resumed run continues the step clock (noise streams /
             # schedules) where the killed run stopped, not at 0
             step = jnp.full((), self._resume_step, jnp.int32)
+            self._fused_host_step = self._resume_step
         state = {"params": params, "aux": aux, "opt": opt_state,
                  "step": step}
         if self._fused.mesh is not None:
@@ -646,12 +657,16 @@ class Module(BaseModule):
             # sentinel readback costs no extra sync point
             import numpy as _np
             sent = _np.asarray(packed)
+            # a skipped step is a device-side no-op: the step clock mirror
+            # must not advance for it either
+            self._fused_host_step += 1 - int(sent[3] > 0)
             guard.on_dispatch(loss_sum=float(sent[0]), nsamp=float(sent[2]),
                               skipped=float(sent[3]),
                               grad_norm=float(sent[4]), nsteps=1)
             guard.last_step_skipped = bool(sent[3] > 0)
             return True
         self._fused_state, outs = self._fused.step(self._fused_state, batch)
+        self._fused_host_step += 1
         # per-worker view of batch-sharded outputs (each worker's metric
         # covers its own shard, matching reference per-worker eval)
         self._fused_outputs = [NDArray(local_view(o)) for o in outs]
@@ -659,32 +674,33 @@ class Module(BaseModule):
         self._params_dirty = True
         return True
 
-    def _try_fused_fit_steps(self, super_batch, eval_metric, guard=None):
-        """fit()'s K-step fast path: one donated ``lax.scan`` dispatch over a
-        stacked superbatch (``TrainStep.run_steps``), with loss/top-1/count
-        accumulated on device and folded into ``eval_metric`` via ONE host
-        readback. Returns False when the configuration needs the general
-        per-step path (which ``fit`` then takes for this superbatch).
+    def _dispatch_fused_steps(self, super_batch, guard=None):
+        """fit()'s K-step fast path, dispatch half: enqueue one donated
+        ``lax.scan`` over a stacked superbatch (``TrainStep.run_steps``)
+        and return the device-resident :class:`~mxnet_tpu.train_step.\
+StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
+        a future, and deferring its ``np.asarray`` is what lets ``fit``'s
+        dispatch pipeline enqueue dispatch N+1 before dispatch N's readback
+        (docs/perf.md "Host off the critical path"). Returns None when the
+        configuration needs the general per-step path.
 
-        With a :class:`~mxnet_tpu.guard.TrainingGuard` the guarded scan runs:
-        its sentinels (skip count, last grad norm) ride back in the SAME
-        packed readback as the metric sums — skipped steps are already
-        excluded from the metric denominators on device — and feed
-        ``guard.on_dispatch``."""
+        The caller MUST retire the result (fold it into the metric, feed
+        the guard, call :meth:`_note_dispatch_retired`) in dispatch order —
+        ``fit``'s ``_consume`` owns that retirement sequence."""
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
-            return False
+            return None
         if self._fused is None:
             if not self._fused_eligible():
-                return False
+                return None
             self._build_fused()
         from ..parallel.mesh import is_multiprocess
         if is_multiprocess(self._fused.mesh):
             # dist workers keep per-step dispatch: the per-step kvstore sync
             # semantics (and per-worker metric shards) are the contract
-            return False
+            return None
         if not getattr(self, "_fused_metrics_ok", False):
-            return False  # multi-head / non-classification: per-step metrics
+            return None  # multi-head / non-classification: per-step metrics
         if self._fused_state is None:
             # dropped by a divergence rollback: reseed from the restored
             # executor params + updater states
@@ -703,18 +719,23 @@ class Module(BaseModule):
         batch = self._fused.shard_superbatch(batch)
         self._fused_state, sums = self._fused.run_steps(
             self._fused_state, batch, guard=guard is not None)
-        from .. import metric as _metric
-        _metric.update_from_device_sums(eval_metric, sums)
-        if guard is not None:
-            guard.on_dispatch(loss_sum=sums.loss_sum,
-                              nsamp=sums.num_samples,
-                              skipped=sums.skipped,
-                              grad_norm=sums.last_grad_norm,
-                              nsteps=super_batch.num_steps)
+        if guard is None:
+            # unguarded: every step lands, the mirror advances at dispatch;
+            # guarded dispatches advance at retirement (skip count is in
+            # the sentinel readback)
+            self._fused_host_step += super_batch.num_steps
         self._fused_outputs = None  # outputs stay on device, un-materialized
         self._fused_dirty = True
         self._params_dirty = True
-        return True
+        return sums
+
+    def _note_dispatch_retired(self, sums, nsteps):
+        """Retirement hook for the dispatch pipeline: advance the host-side
+        step-clock mirror for a GUARDED dispatch once its sentinels (the
+        device-side skip count) have been fetched. Unguarded dispatches
+        advanced at dispatch time."""
+        if getattr(sums, "guarded", False):
+            self._fused_host_step += int(nsteps) - sums.skipped
 
     def _sync_fused_to_executor(self):
         """Write fused params/aux back into the executor arrays (copies —
@@ -751,6 +772,37 @@ class Module(BaseModule):
         for n, st in self._fused_state["opt"].items():
             if n in idx_of:
                 updater.states[idx_of[n]] = to_nd(st)
+
+    def _snapshot_opt_states(self):
+        """Decoupled optimizer-state snapshot for the async checkpoint
+        writer (model.AsyncCheckpointWriter): only device-side copies
+        happen here; the returned callable does the D2H + pickle on the
+        writer thread, byte-identical to ``save_optimizer_states`` over the
+        same state. The copies matter: the imperative updater mutates its
+        state arrays in place per step, so an un-decoupled snapshot would
+        race later training. None when this module cannot snapshot (e.g. a
+        dist kvstore owns the states) — the manager then saves
+        synchronously."""
+        if not self.optimizer_initialized:
+            return None
+        self._sync_fused_opt_states()
+        updater = self._resolve_updater()
+        if updater is None or not hasattr(updater, "states"):
+            return None
+        from ..ndarray import NDArray
+        from ..optimizer import Updater
+
+        def cp(x):
+            if x is None:
+                return None
+            if isinstance(x, tuple):
+                return tuple(cp(i) for i in x)
+            if isinstance(x, NDArray):
+                return NDArray(self._jnp_copy(x.data))
+            return x
+
+        states = {k: cp(v) for k, v in updater.states.items()}
+        return lambda: Updater.serialize_states(states)
 
     # -- computation ----------------------------------------------------
     def forward(self, data_batch, is_train=None):

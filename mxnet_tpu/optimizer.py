@@ -610,7 +610,13 @@ class Updater(object):
             return x
         self.states = {k: dev(v) for k, v in pickle.loads(states).items()}
 
-    def get_states(self):
+    @staticmethod
+    def serialize_states(states):
+        """Pickle an index->state dict with device arrays landed to host.
+        Shared by :meth:`get_states` and the async checkpoint writer's
+        decoupled snapshot (model.AsyncCheckpointWriter): identical state
+        dicts must serialize to identical bytes, or the async-vs-sync
+        checkpoint byte-parity contract breaks."""
         import pickle
 
         def host(x):
@@ -619,7 +625,10 @@ class Updater(object):
             if isinstance(x, tuple):
                 return tuple(host(i) for i in x)
             return x
-        return pickle.dumps({k: host(v) for k, v in self.states.items()})
+        return pickle.dumps({k: host(v) for k, v in states.items()})
+
+    def get_states(self):
+        return self.serialize_states(self.states)
 
 
 def get_updater(optimizer):

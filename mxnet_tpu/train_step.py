@@ -62,6 +62,21 @@ class StepMetrics(object):
             self._host = np.asarray(self.device)
         return self._host
 
+    def fetch(self):
+        """Perform the dispatch's one host readback NOW (idempotent) and
+        return self. The packed device array is a future: ``fit``'s
+        dispatch pipeline (docs/perf.md "Host off the critical path")
+        defers this call until the NEXT dispatch has been enqueued, so the
+        readback stall overlaps device compute instead of serializing it."""
+        self._vals()
+        return self
+
+    @property
+    def fetched(self):
+        """True once the host readback has happened (property access or
+        :meth:`fetch`) — reading it never syncs the device."""
+        return self._host is not None
+
     @property
     def loss_sum(self):
         """Summed cross-entropy over every sample in the dispatch."""

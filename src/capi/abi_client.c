@@ -327,6 +327,53 @@ int main(void) {
         printf("autograd: d(x^2)/dx = [%g %g %g]\n", gv[0], gv[1], gv[2]);
     }
 
+    /* ---- 4b. imperative invoke with caller-supplied outputs ----
+     * reference contract: *outputs != NULL means write IN PLACE into the
+     * existing NDArray handles (out= semantics) — the handle array, count
+     * and handles must survive untouched, only the data changes. */
+    {
+        uint32_t sh[] = {3};
+        H x2, o;
+        CHK(MXNDArrayCreate(sh, 1, 1, 0, 0, &x2));
+        CHK(MXNDArrayCreate(sh, 1, 1, 0, 0, &o));
+        float xv[] = {1.f, 2.f, 3.f};
+        float ov[] = {-1.f, -1.f, -1.f};
+        CHK(MXNDArraySyncCopyFromCPU(x2, xv, 3));
+        CHK(MXNDArraySyncCopyFromCPU(o, ov, 3));
+        H fsq;
+        CHK(MXGetFunction("square", &fsq));
+        H ins[] = {x2};
+        H out_buf[] = {o};
+        H *outs = out_buf; /* non-NULL on entry: in-place contract */
+        int n_out = 1;
+        CHK(MXImperativeInvoke(fsq, 1, ins, &n_out, &outs, 0, NULL, NULL));
+        if (outs != out_buf || n_out != 1 || outs[0] != o) {
+            fprintf(stderr, "in-place invoke replaced caller handles\n");
+            return 1;
+        }
+        float rv[3];
+        CHK(MXNDArraySyncCopyToCPU(o, rv, 3));
+        if (rv[0] != 1.f || rv[1] != 4.f || rv[2] != 9.f) {
+            fprintf(stderr, "in-place invoke wrong: %f %f %f\n", rv[0],
+                    rv[1], rv[2]);
+            return 1;
+        }
+        /* a count mismatch must fail loudly, never truncate/overrun */
+        H bad_buf[] = {o, x2};
+        H *bad = bad_buf;
+        int n_bad = 2;
+        if (MXImperativeInvoke(fsq, 1, ins, &n_bad, &bad, 0, NULL, NULL)
+                == 0) {
+            fprintf(stderr, "in-place invoke accepted a wrong output "
+                            "count\n");
+            return 1;
+        }
+        printf("imperative in-place: square -> [%g %g %g]\n", rv[0], rv[1],
+               rv[2]);
+        CHK(MXNDArrayFree(x2));
+        CHK(MXNDArrayFree(o));
+    }
+
     /* ---- 5. RecordIO ---- */
     {
         H w, r;

@@ -1085,16 +1085,32 @@ MXTPU_EXPORT int MXImperativeInvoke(AtomicSymbolCreator creator,
             PyDict_SetItemString(attrs, param_keys[i], pv);
             Py_XDECREF(pv);
         }
-        PyObject *v = capi_call(
-            "MXImperativeInvoke",
-            Py_BuildValue("(NNN)", pname,
-                          hlist(inputs, (uint32_t)num_inputs), attrs));
-        if (v) {
-            mx_uint n = 0;
-            *outputs = hslot_fill(1, v, &n);
-            *num_outputs = (int)n;
-            Py_DECREF(v);
-            rc = 0;
+        if (*outputs != NULL) {
+            /* reference contract (c_api_ndarray.cc): a caller-supplied
+             * output array means write-in-place into those existing
+             * NDArray handles (out= semantics) — the handle array, the
+             * count and the handles themselves are left untouched */
+            PyObject *v = capi_call(
+                "MXImperativeInvokeInPlace",
+                Py_BuildValue("(NNNN)", pname,
+                              hlist(inputs, (uint32_t)num_inputs), attrs,
+                              hlist(*outputs, (uint32_t)*num_outputs)));
+            if (v) {
+                Py_DECREF(v);
+                rc = 0;
+            }
+        } else {
+            PyObject *v = capi_call(
+                "MXImperativeInvoke",
+                Py_BuildValue("(NNN)", pname,
+                              hlist(inputs, (uint32_t)num_inputs), attrs));
+            if (v) {
+                mx_uint n = 0;
+                *outputs = hslot_fill(1, v, &n);
+                *num_outputs = (int)n;
+                Py_DECREF(v);
+                rc = 0;
+            }
         }
     }
     PyGILState_Release(st);

@@ -28,6 +28,24 @@ def _mlp():
 
 
 def main(prefix, out_npz, k):
+    # async-checkpoint kill test support: the parent arms a delay on the
+    # writer thread (via env, so the SIGKILL lands mid-async-save while
+    # the train loop races ahead); MXTPU_ASYNC_CKPT itself is read by fit
+    delay = float(os.environ.get("RESUME_WORKER_ASYNC_DELAY", "0") or 0)
+    nth = int(os.environ.get("RESUME_WORKER_ASYNC_DELAY_NTH", "0") or 0)
+    if delay > 0 and nth > 0:
+        from mxnet_tpu import faults
+        faults.inject("ckpt.async_write", nth=nth, kind="delay",
+                      delay=delay)
+    # pace the first epoch-0 saves so the parent can rely on save #N-1
+    # being durably on disk before the delayed save #N's job starts
+    drain_until = int(os.environ.get("RESUME_WORKER_DRAIN_UNTIL", "0") or 0)
+    ckpt_arg = prefix
+    mgr = None
+    if drain_until:
+        from mxnet_tpu.model import CheckpointManager
+        mgr = CheckpointManager(prefix, keep=3)
+        ckpt_arg = mgr
     mx.random.seed(7)
     rng = np.random.default_rng(3)
     X = rng.normal(size=(256, 10)).astype(np.float32)
@@ -38,6 +56,9 @@ def main(prefix, out_npz, k):
 
     def cb(param):
         print("BATCH %d.%d" % (param.epoch, param.nbatch), flush=True)
+        if mgr is not None and param.epoch == 0 \
+                and param.nbatch < drain_until:
+            mgr.drain()
 
     from mxnet_tpu import lr_scheduler
     mod.fit(train, num_epoch=2, steps_per_dispatch=k,
@@ -45,7 +66,7 @@ def main(prefix, out_npz, k):
                               "lr_scheduler": lr_scheduler.FactorScheduler(
                                   step=10, factor=0.5)},
             batch_end_callback=cb,
-            checkpoint_prefix=prefix, checkpoint_every_n_batches=4,
+            checkpoint_prefix=ckpt_arg, checkpoint_every_n_batches=4,
             resume="auto")
     arg, aux = mod.get_params()
     np.savez(out_npz, **{n: v.asnumpy() for n, v in arg.items()})

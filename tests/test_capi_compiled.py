@@ -65,6 +65,8 @@ def test_abi_client_families():
     assert "ABI PASS" in r.stdout
     assert "introspection: 2" in r.stdout  # 200+ ops through the ABI
     assert "updater calls" in r.stdout
+    # caller-supplied *outputs != NULL: write-in-place contract (ISSUE 4)
+    assert "imperative in-place: square -> [1 4 9]" in r.stdout
 
 
 def test_abi_covers_all_114_reference_functions():

@@ -435,6 +435,78 @@ def test_sigkill_and_resume_bitwise_identical(tmp_path, k):
         np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
 
 
+@pytest.mark.slow
+def test_sigkill_mid_async_save_resumes_from_previous(tmp_path):
+    """SIGKILL while an ASYNC checkpoint save is mid-write (the writer
+    thread is stalled inside the job via the ckpt.async_write delay site):
+    the torn save must never become `latest`, resume must land on the
+    previous valid checkpoint, and the re-run must still produce
+    bitwise-identical final params (docs/robustness.md "Asynchronous
+    checkpointing")."""
+    import signal
+    import subprocess
+    import sys
+    import time
+    worker = os.path.join(os.path.dirname(__file__), "resume_worker.py")
+    k = 2
+
+    def launch(prefix, out, extra_env=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable, worker, prefix, out, str(k)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+
+    # reference: uninterrupted SYNC run (async must be byte/bit-equivalent)
+    ref_out = str(tmp_path / "ref.npz")
+    p = launch(str(tmp_path / "ref-ck"), ref_out)
+    assert p.wait(timeout=600) == 0, p.stdout.read()
+
+    # victim: async checkpointing on, with the SECOND async save's writer
+    # stalled 300s inside the job — the training loop races ahead (that is
+    # the point of async saves), we kill it mid-save
+    prefix = str(tmp_path / "ck")
+    out = str(tmp_path / "resumed.npz")
+    p = launch(prefix, out, {"MXTPU_ASYNC_CKPT": "1",
+                             "RESUME_WORKER_ASYNC_DELAY": "300",
+                             "RESUME_WORKER_ASYNC_DELAY_NTH": "2",
+                             # save #1 (b4) is drained to disk before b8
+                             # submits, so the 300s stall is exactly the
+                             # SECOND save's job — deterministically
+                             "RESUME_WORKER_DRAIN_UNTIL": "6"})
+    killed = False
+    deadline = time.monotonic() + 600
+    for line in p.stdout:
+        # cadence 4, 16 batches/epoch: save #2 (b8) submits after batch
+        # 0.7; kill while its writer sleeps and the loop keeps training
+        if line.startswith("BATCH 0.13") and time.monotonic() < deadline:
+            os.kill(p.pid, signal.SIGKILL)
+            killed = True
+            break
+    p.wait(timeout=60)
+    assert killed, "worker finished before it could be killed"
+    assert not os.path.exists(out)
+
+    # the stalled save must have left NO trace under the live names:
+    # resume lands on save #1 (e0000-b00000004), not the torn #2
+    mgr = CheckpointManager(prefix)
+    st = mgr.load_latest()
+    assert st is not None and st.known_good is True
+    assert st.tag == "e0000-b00000004", st.tag
+    assert open(mgr.latest_path).read().strip() == "e0000-b00000004"
+
+    # re-run (async on, no fault): resumes from the previous valid
+    # checkpoint and finishes bitwise-identical to the sync reference
+    p = launch(prefix, out, {"MXTPU_ASYNC_CKPT": "1"})
+    assert p.wait(timeout=600) == 0, p.stdout.read()
+    ref = np.load(ref_out)
+    got = np.load(out)
+    assert sorted(ref.files) == sorted(got.files)
+    for name in ref.files:
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
+
+
 def test_load_latest_prefers_newer_tag_over_stale_pointer(tmp_path):
     # crash between the manifest write and the latest-pointer write: the
     # newest on-disk checkpoint must win over the stale pointer
