@@ -149,6 +149,7 @@ def host_overhead_main():
                                       > best_async["images_per_sec"]):
                 best_async = asyn
 
+    from mxnet_tpu import tracecheck
     out = {
         "metric": "resnet%d_host_overhead_b%d_k%d" % (depth, batch, k),
         "value": best_async["images_per_sec"],
@@ -156,6 +157,9 @@ def host_overhead_main():
         "steps_per_dispatch": k,
         "pipeline_depth": pl_depth,
         "host_stall_frac": best_async["host_stall_frac"],
+        # unexpected jit-cache misses over the whole sweep: a nonzero count
+        # means a config retraced a seen program (docs/static_analysis.md)
+        "retraces": tracecheck.retrace_count(),
         "sweep": sweep,
     }
     print(json.dumps(out))
@@ -288,11 +292,15 @@ def main():
         metric += "_store_%s" % sdtype
     if spd > 1:
         metric += "_k%d" % spd
+    from mxnet_tpu import tracecheck
     out = {
         "metric": metric,
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / baseline, 3),
+        # unexpected jit-cache misses during the measured run — a retrace
+        # storm invalidates the steady-state number (docs/static_analysis.md)
+        "retraces": tracecheck.retrace_count(),
     }
     if spd > 1:
         out["steps_per_dispatch"] = spd

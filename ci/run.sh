@@ -14,6 +14,9 @@ make -C perl-package
 (cd perl-package && PYTHONPATH=.. JAX_PLATFORMS=cpu perl predict.pl)
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/ -q
+# static lints over the model zoo's compiled step programs
+# (docs/static_analysis.md; tier-1 keeps a faster 2-model smoke)
+./ci/tracecheck.sh
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 # chip stage: hard convergence gates + the ImageNet recipe compile-check
 # (uses the real TPU when attached; tools default to the ambient platform).

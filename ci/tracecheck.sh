@@ -1,0 +1,19 @@
+#!/bin/sh
+# CI gate: static-analyze the model zoo's compiled step programs
+# (docs/static_analysis.md). Runs the tracecheck CLI over every shipped
+# model's step / scan / guarded-step / guarded-scan lowering — no step
+# program executes — and fails on any NEW unsuppressed finding
+# (host-sync, donation, const-capture, dtype-f64, dtype-weak).
+#
+# Usage: ci/tracecheck.sh [model,model,...]   (default: the whole zoo)
+set -e
+cd "$(dirname "$0")/.."
+MODELS="$1"
+if [ -n "$MODELS" ]; then
+    set -- --models "$MODELS"
+else
+    set -- --zoo
+fi
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    python -m mxnet_tpu.tracecheck "$@"
+echo "tracecheck PASS"

@@ -62,6 +62,7 @@ class Speedometer(object):
         self.last_count = 0
         self._fired = 0
         self._stall_seen = 0.0  # pipeline host_stall at the last fire
+        self._retrace_base = None  # tracecheck retrace count at init-fire
 
     @staticmethod
     def _health_suffix(param):
@@ -104,6 +105,20 @@ class Speedometer(object):
         return ("\tPipeline: depth=%d host_stall=%.3fs"
                 % (p.depth, window))
 
+    def _retrace_suffix(self):
+        """``Retraces: N`` once any watched jit entry has unexpectedly
+        re-traced since this Speedometer started (docs/static_analysis.md):
+        a jit-cache-miss storm — every retrace is a full recompile — shows
+        up in the training log itself, not just as a benchmark delta. The
+        count is baselined at the first (init) fire so one run's misses
+        never leak into another run's lines."""
+        from . import tracecheck
+        n = tracecheck.retrace_count()
+        if self._retrace_base is None:
+            self._retrace_base = n
+        n -= self._retrace_base
+        return "\tRetraces: %d" % n if n else ""
+
     def __call__(self, param):
         count = param.nbatch
         if self.last_count > count:
@@ -118,7 +133,8 @@ class Speedometer(object):
                 speed = ((count - self._fired) * self.batch_size
                          / (time.time() - self.tic))
                 health = self._health_suffix(param) \
-                    + self._pipeline_suffix(param)
+                    + self._pipeline_suffix(param) \
+                    + self._retrace_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     param.eval_metric.reset()
@@ -137,9 +153,13 @@ class Speedometer(object):
             self.init = True
             self._fired = count
             self.tic = time.time()
-            # baseline the pipeline stall counter so the first fired
-            # window reports its own stall, not the whole run-up
+            # baseline the pipeline stall + retrace counters so the first
+            # fired window reports its own stall/misses, not the run-up —
+            # re-baselined on every (re-)init so a reused Speedometer never
+            # reports another run's cache misses
             self._pipeline_suffix(param)
+            self._retrace_base = None
+            self._retrace_suffix()
 
 
 class ProgressBar(object):

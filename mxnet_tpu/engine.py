@@ -97,6 +97,41 @@ def set_dispatch_pipeline(depth):
     return prev
 
 
+_tracecheck_override = None
+
+
+def tracecheck_mode():
+    """Retrace-policy mode for the static analyzer's runtime hooks
+    (docs/static_analysis.md): ``"warn"`` (default) logs the cache-key
+    diff when a watched jit entry unexpectedly retraces, ``"error"``
+    raises :class:`~mxnet_tpu.base.MXNetError`, ``"off"`` disables
+    signature capture. Env default: ``MXTPU_TRACECHECK``."""
+    if _tracecheck_override is not None:
+        return _tracecheck_override
+    v = os.environ.get("MXTPU_TRACECHECK", "").strip().lower()
+    if v in ("", "1", "on", "true", "warn", "warning"):
+        return "warn"
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v in ("error", "raise"):
+        return "error"
+    from .base import MXNetError
+    raise MXNetError("MXTPU_TRACECHECK must be warn|error|off, got %r" % v)
+
+
+def set_tracecheck(mode):
+    """Override the tracecheck mode (None = back to the env/default);
+    returns the previous effective value."""
+    global _tracecheck_override
+    prev = tracecheck_mode()
+    if mode is not None and mode not in ("warn", "error", "off"):
+        from .base import MXNetError
+        raise MXNetError("set_tracecheck: mode must be warn|error|off or "
+                         "None, got %r" % (mode,))
+    _tracecheck_override = mode
+    return prev
+
+
 def maybe_sync(arr):
     """Called after each imperative op; blocks in naive mode."""
     if _naive and arr is not None:

@@ -378,12 +378,18 @@ def update_from_device_sums(metric, sums):
         for m in metric.metrics:
             update_from_device_sums(m, sums)
         return
+    # fold through Python float/int regardless of what the sums object
+    # yields: under NEP 50 a stray np.float32 in `0.0 + x` DEMOTES the
+    # host accumulator to float32 for the rest of the run — past 2**24
+    # accumulated samples `+= 1`-sized increments stop landing. The f64
+    # fold is bitwise-identical at small counts (parity-tested;
+    # docs/static_analysis.md)
     if type(metric) is Accuracy:
-        metric.sum_metric += sums.top1_correct
-        metric.num_inst += sums.num_samples
+        metric.sum_metric += float(sums.top1_correct)
+        metric.num_inst += int(sums.num_samples)
     elif type(metric) is CrossEntropy:
-        metric.sum_metric += sums.loss_sum
-        metric.num_inst += sums.num_samples
+        metric.sum_metric += float(sums.loss_sum)
+        metric.num_inst += int(sums.num_samples)
     else:
         raise MXNetError(
             "%s cannot consume dispatch-level sums; train with "

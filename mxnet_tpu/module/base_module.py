@@ -571,12 +571,14 @@ class BaseModule(object):
                                  (toc - tic))
                 if guard is not None:
                     h = guard.health.report()
-                    if h["skipped"] or h["rollbacks"]:
+                    if h["skipped"] or h["rollbacks"] or h["retraces"]:
                         self.logger.info(
                             "Epoch[%d] TrainingHealth: skipped=%d "
-                            "rollbacks=%d divergences=%d last_grad_norm=%s",
+                            "rollbacks=%d divergences=%d retraces=%d "
+                            "last_grad_norm=%s",
                             epoch, h["skipped"], h["rollbacks"],
-                            h["divergences"], h["last_grad_norm"])
+                            h["divergences"], h["retraces"],
+                            h["last_grad_norm"])
 
                 arg_params, aux_params = self.get_params()
                 self.set_params(arg_params, aux_params)

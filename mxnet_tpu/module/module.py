@@ -19,6 +19,7 @@ from .. import kvstore as _kvstore
 from ..model import (_create_kvstore, _initialize_kvstore,
                      _update_params, _update_params_on_kvstore,
                      load_checkpoint)
+from ..tracecheck import RetraceError
 from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
 
@@ -646,10 +647,17 @@ class Module(BaseModule):
                 {k: _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
                  for k, v in batch.items()})
         from ..ndarray import NDArray
+        # retrace events attribute to THIS run's health when guarded (the
+        # process-global TRAINING_HEALTH mirror always counts them)
+        self._fused.health = guard.health if guard is not None else None
         if guard is not None:
             guard.last_step_skipped = False
-            self._fused_state, outs, packed = self._fused.step(
-                self._fused_state, batch, guard=True)
+            try:
+                self._fused_state, outs, packed = self._fused.step(
+                    self._fused_state, batch, guard=True)
+            except RetraceError as e:
+                self._adopt_retrace_result(e, 1, guard)
+                raise
             self._fused_outputs = [NDArray(local_view(o)) for o in outs]
             self._fused_dirty = True
             self._params_dirty = True
@@ -665,7 +673,12 @@ class Module(BaseModule):
                               grad_norm=float(sent[4]), nsteps=1)
             guard.last_step_skipped = bool(sent[3] > 0)
             return True
-        self._fused_state, outs = self._fused.step(self._fused_state, batch)
+        try:
+            self._fused_state, outs = self._fused.step(
+                self._fused_state, batch)
+        except RetraceError as e:
+            self._adopt_retrace_result(e, 1, None)
+            raise
         self._fused_host_step += 1
         # per-worker view of batch-sharded outputs (each worker's metric
         # covers its own shard, matching reference per-worker eval)
@@ -717,8 +730,13 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
             for name, value in zip(eg.label_names, super_batch.label):
                 batch[name] = value
         batch = self._fused.shard_superbatch(batch)
-        self._fused_state, sums = self._fused.run_steps(
-            self._fused_state, batch, guard=guard is not None)
+        self._fused.health = guard.health if guard is not None else None
+        try:
+            self._fused_state, sums = self._fused.run_steps(
+                self._fused_state, batch, guard=guard is not None)
+        except RetraceError as e:
+            self._adopt_retrace_result(e, super_batch.num_steps, guard)
+            raise
         if guard is None:
             # unguarded: every step lands, the mirror advances at dispatch;
             # guarded dispatches advance at retirement (skip count is in
@@ -728,6 +746,32 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
         self._fused_dirty = True
         self._params_dirty = True
         return sums
+
+    def _adopt_retrace_result(self, e, nsteps, guard):
+        """``MXTPU_TRACECHECK=error`` raised mid-dispatch
+        (tracecheck.RetraceError): the dispatch already ran and DONATED the
+        previous fused state, and the new state rides in ``e.result`` —
+        adopt it so ``_fused_state`` never dangles on deleted buffers
+        (``get_params`` / emergency checkpoints after catching the error
+        keep working). The step-clock mirror advances as on the success
+        path; the run is aborting, so the guarded paths' sentinel readback
+        costs nothing that matters."""
+        if e.result is None:
+            return
+        self._fused_state = e.result[0]
+        self._fused_outputs = None
+        self._fused_dirty = True
+        self._params_dirty = True
+        if guard is None:
+            self._fused_host_step += nsteps
+            return
+        import numpy as _np
+        tail = e.result[-1]
+        if hasattr(tail, "skipped"):   # StepMetrics (run_steps path)
+            skipped = int(tail.skipped)
+        else:                          # packed sentinel array (step path)
+            skipped = int(_np.asarray(tail)[3] > 0)
+        self._fused_host_step += nsteps - skipped
 
     def _note_dispatch_retired(self, sums, nsteps):
         """Retirement hook for the dispatch pipeline: advance the host-side

@@ -310,3 +310,72 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
             assert_almost_equal(r0, r, rtol=tol[np.dtype(np.float32)],
                                 atol=1e-3)
     return results
+
+
+class assert_no_retrace(object):
+    """Context manager asserting that no watched jit entry re-traces inside
+    the block (docs/static_analysis.md "Retrace explainer").
+
+    Built on the tracecheck cache-key differ: the block runs with
+    ``MXTPU_TRACECHECK`` forced on, the process-global
+    ``tracecheck.RETRACE_EVENTS`` log is snapshotted on entry, and any event
+    appended during the block fails the assertion with the differ's output
+    — naming the argument and property (shape / dtype / weak-type / static
+    value) whose change caused the jit-cache miss.
+
+    Explicitly-passed jitted functions are additionally pinned by raw cache
+    size, catching retraces on entries the runtime watcher does not cover::
+
+        with assert_no_retrace(ts._jit_scan[(bs, k)]):
+            for epoch in range(3):
+                state, _ = ts.run_steps(state, superbatch)
+
+    Generalizes the PR-1 no-retrace-across-epochs check; applied to the
+    guarded scan, the pipelined fit and the post-rollback resume paths in
+    the test suite.
+    """
+
+    def __init__(self, *jitfns, msg=None):
+        self._jitfns = jitfns
+        self._msg = msg
+        self._events0 = 0
+        self._sizes0 = ()
+        self._prev_mode = None
+
+    def __enter__(self):
+        from . import engine, tracecheck
+        # signature capture must be live for the differ to have anything to
+        # report; restore the caller's mode on exit
+        if engine.tracecheck_mode() == "off":
+            self._prev_mode = engine.set_tracecheck("warn")
+        self._events0 = len(tracecheck.RETRACE_EVENTS)
+        self._sizes0 = tuple(self._cache_size(f) for f in self._jitfns)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        from . import engine, tracecheck
+        if self._prev_mode is not None:
+            engine.set_tracecheck(self._prev_mode)
+        if exc_type is not None:
+            return False
+        lines = []
+        for ev in tracecheck.RETRACE_EVENTS[self._events0:]:
+            lines.append("retrace at %s: %s" % (ev.site, "; ".join(ev.diff)))
+        for f, s0 in zip(self._jitfns, self._sizes0):
+            s1 = self._cache_size(f)
+            if s0 is not None and s1 is not None and s1 > s0:
+                lines.append("jit cache of %r grew %d -> %d (re-traced)"
+                             % (getattr(f, "__name__", f), s0, s1))
+        if lines:
+            prefix = (self._msg + ": ") if self._msg else ""
+            raise AssertionError(prefix + "unexpected retrace inside "
+                                 "assert_no_retrace block\n  "
+                                 + "\n  ".join(lines))
+        return False
+
+    @staticmethod
+    def _cache_size(f):
+        try:
+            return f._cache_size()
+        except Exception:
+            return None

@@ -28,6 +28,10 @@ from . import optimizer as _opt
 from .optimizer import Optimizer
 from . import random as _random
 
+#: monotonic id for tracecheck watcher names — registry names must stay
+#: unique across TrainStep instances even when symbols share a name
+_TC_WATCHER_SEQ = 0
+
 P = jax.sharding.PartitionSpec
 
 # rng stream offset so optimizer noise keys (SGLD) never collide with the
@@ -137,7 +141,10 @@ def _metric_step_sums(outs, batch, label_names, zero):
                 and lbl.ndim == 1 and o.shape[0] == lbl.shape[0]):
             li = lbl.astype(jnp.int32)
             p = o[jnp.arange(o.shape[0]), li].astype(jnp.float32)
-            loss = loss + jnp.sum(-jnp.log(p + 1e-8))
+            # eps pinned f32: a bare Python 1e-8 is weak-typed and would
+            # promote to f64 under jax_enable_x64 (tracecheck dtype lint);
+            # on the default config the pin is bitwise-identical
+            loss = loss + jnp.sum(-jnp.log(p + jnp.float32(1e-8)))
             correct = correct + jnp.sum(
                 (jnp.argmax(o, axis=1).astype(jnp.int32) == li)
                 .astype(jnp.float32))
@@ -238,6 +245,14 @@ class TrainStep(object):
         self._jit_g = {}
         self._jit_scan_g = {}
         self._base_key = None  # drawn lazily from the global seeded stream
+        self._static_key = None  # cached no-rng key (one H2D, not per-step)
+        # tracecheck runtime hooks (docs/static_analysis.md): every jit
+        # cache entry registers with the program registry so the guard-on /
+        # guard-off / scan program set is auditable as a unit, and every
+        # dispatch records its call signature so an unexpected cache miss
+        # logs (or raises, MXTPU_TRACECHECK=error) the cache-key diff
+        self._watcher = None
+        self.health = None  # per-run TrainingHealth (Module attaches it)
 
     # ------------------------------------------------------------------
     def _wrap_remat(self, run):
@@ -265,7 +280,17 @@ class TrainStep(object):
 
     # ------------------------------------------------------------------
     def init(self, data_shapes, label_shapes=None, initializer=None, seed=0):
-        """Allocate and initialize state from inferred shapes."""
+        """Allocate and initialize state from inferred shapes.
+
+        Runs under ``jax.transfer_guard("allow")``: init is setup, not the
+        dispatch hot loop — host-to-device transfers are its job. The
+        tracecheck runtime contract (``tracecheck``-marked tests under
+        ``transfer_guard("disallow")``, docs/static_analysis.md) polices
+        the per-dispatch path only."""
+        with jax.transfer_guard("allow"):
+            return self._init(data_shapes, label_shapes, initializer, seed)
+
+    def _init(self, data_shapes, label_shapes, initializer, seed):
         shapes = dict(data_shapes)
         shapes.update(label_shapes or {})
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
@@ -443,7 +468,7 @@ class TrainStep(object):
             cots_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_up)
             (grads,) = vjp_fn((cots, cots_aux))
 
-            t = state["step"].astype(jnp.float32) + 1.0
+            t = state["step"].astype(jnp.float32) + jnp.float32(1.0)
             gs = {n: grads[n].astype(params[n].dtype) * rescale
                   for n in updated}
             if poison is not None:
@@ -460,7 +485,8 @@ class TrainStep(object):
             if clip_norm is not None:
                 scale = jnp.minimum(
                     jnp.float32(1.0),
-                    jnp.float32(clip_norm) / jnp.maximum(gnorm, 1e-12))
+                    jnp.float32(clip_norm)
+                    / jnp.maximum(gnorm, jnp.float32(1e-12)))
                 gs = {n: g * scale.astype(g.dtype) for n, g in gs.items()}
             ok = None
             if guard:
@@ -525,7 +551,7 @@ class TrainStep(object):
             okf = ok.astype(jnp.float32)
             packed = jnp.stack([
                 jnp.where(ok, loss, zero), jnp.where(ok, correct, zero),
-                okf * jnp.float32(batch_size), 1.0 - okf,
+                okf * jnp.float32(batch_size), jnp.float32(1.0) - okf,
                 gnorm.astype(jnp.float32)])
             return new_st, outs, packed
 
@@ -604,9 +630,16 @@ class TrainStep(object):
             # dropout/SGLD respond to seeding and two TrainSteps never share
             # noise; per-step keys fold in the step counter
             if self._base_key is None:
-                self._base_key = _random.split()
+                with jax.transfer_guard("allow"):  # one-time key creation
+                    self._base_key = _random.split()
             return self._base_key  # per-step variation folds in state["step"]
-        return jax.random.key(0)  # static; unused ops ignore it
+        if self._static_key is None:
+            # cached: creating a fresh key would cost an (implicit) H2D
+            # per dispatch — the transfer-guard runtime lint flags exactly
+            # this pattern inside the hot loop
+            with jax.transfer_guard("allow"):
+                self._static_key = jax.random.key(0)
+        return self._static_key  # static; unused ops ignore it
 
     def _next_lr(self):
         # scheduler clock advances host-side; lr rides in as a traced scalar
@@ -624,6 +657,51 @@ class TrainStep(object):
             [float("nan") if _faults.fire_flag("guard.grad_nan") else 0.0
              for _ in range(k)], np.float32)
 
+    def _tc_after(self, kind, cache_key, jitfn, call_args, result=None):
+        """tracecheck runtime hook (docs/static_analysis.md), called right
+        after a watched jit call: registers the program with the analyzer's
+        registry (first call per cache entry — the guard-on / guard-off /
+        scan program set is auditable as a unit via
+        ``tracecheck.check_registered``) and feeds the call signature to the
+        per-TrainStep retrace watcher, so an unexpected jit-cache miss logs
+        — or raises under ``MXTPU_TRACECHECK=error`` — a diff naming the
+        offending argument. Signature/struct capture is metadata-only
+        (shape/dtype/weak-type), so the donated state buffers are safe to
+        sign post-call; the dispatch is already enqueued, so this host work
+        overlaps device compute."""
+        from . import tracecheck as _tc
+        if not _tc.enabled():
+            return
+        if self._watcher is None:
+            # names are process-unique: two TrainSteps over same-named
+            # symbols (the default "softmax" head is common) must not
+            # collide in the program registry, or the second instance's
+            # programs would never register and check_registered would
+            # silently audit the wrong instance's program set
+            global _TC_WATCHER_SEQ
+            _TC_WATCHER_SEQ += 1
+            base = "TrainStep(%s)" % (self.symbol.name,)
+            if _TC_WATCHER_SEQ > 1:
+                base += "#%d" % _TC_WATCHER_SEQ
+            self._watcher = _tc.TraceWatcher(base)
+        if isinstance(cache_key, tuple):
+            key = "%s[bs=%d,k=%d]" % ((kind,) + tuple(cache_key))
+        else:
+            key = "%s[bs=%d]" % (kind, cache_key)
+        name = "%s/%s" % (self._watcher.name, key)
+        if name not in _tc.PROGRAMS:
+            _tc.register_program(name, jitfn, call_args,
+                                 donate_argnums=(0,))
+        try:
+            self._watcher.after_call(key, jitfn, _tc.signature(call_args),
+                                     health=self.health)
+        except _tc.RetraceError as e:
+            # the dispatch already ran and donated the old state: hand the
+            # new state to the caller through the exception so it never
+            # holds a reference to deleted buffers
+            e.result = result
+            raise
+
     def step(self, state, batch, guard=False):
         """One fused train step. ``batch``: dict name -> array.
 
@@ -635,14 +713,26 @@ class TrainStep(object):
         if guard:
             if bs not in self._jit_g:
                 self._jit_g[bs] = self._build_guard_step(bs)
-            return self._jit_g[bs](
-                state, batch, self._dispatch_key(),
-                jnp.asarray(self._next_lr(), jnp.float32),
-                jnp.asarray(self._poison_scalars(1)[0]))
+            fn = self._jit_g[bs]
+            # 0-d np.asarray pins (see run_steps): explicit dtype + explicit
+            # device transfer for the per-step lr/poison scalars (a bare
+            # numpy SCALAR still rides the implicit-transfer path)
+            call_args = (state, batch, self._dispatch_key(),
+                         jnp.asarray(np.asarray(self._next_lr(),
+                                                np.float32)),
+                         jnp.asarray(np.asarray(
+                             self._poison_scalars(1)[0], np.float32)))
+            out = fn(*call_args)
+            self._tc_after("guard-step", bs, fn, call_args, result=out)
+            return out
         if bs not in self._jit:
             self._jit[bs] = self._build(bs)
-        return self._jit[bs](state, batch, self._dispatch_key(),
-                             jnp.asarray(self._next_lr(), jnp.float32))
+        fn = self._jit[bs]
+        call_args = (state, batch, self._dispatch_key(),
+                     jnp.asarray(np.asarray(self._next_lr(), np.float32)))
+        out = fn(*call_args)
+        self._tc_after("step", bs, fn, call_args, result=out)
+        return out
 
     def run_steps(self, state, superbatch, k=None, guard=False):
         """Run K fused train steps in ONE compiled dispatch.
@@ -682,15 +772,29 @@ class TrainStep(object):
         cache = self._jit_scan_g if guard else self._jit_scan
         if (bs, k) not in cache:
             cache[(bs, k)] = self._build_scan(bs, k, guard=guard)
-        lrs = jnp.asarray([self._next_lr() for _ in range(k)], jnp.float32)
+        fn = cache[(bs, k)]
+        # lr vector pinned through np.float32 BEFORE the device transfer:
+        # the explicit f32 pin keeps the trace weak-type-free under any
+        # jax config (tracecheck dtype lint), and jnp.asarray of a host
+        # numpy array is an EXPLICIT transfer — a bare Python list would
+        # ride an implicit one, which the transfer-guard runtime lint
+        # rejects in the dispatch hot loop
+        lrs = jnp.asarray(np.asarray([self._next_lr() for _ in range(k)],
+                                     np.float32))
         if guard:
-            new_state, packed = cache[(bs, k)](
-                state, superbatch, self._dispatch_key(), lrs,
-                jnp.asarray(self._poison_scalars(k)))
-            return new_state, StepMetrics(packed, guarded=True)
-        new_state, packed = cache[(bs, k)](
-            state, superbatch, self._dispatch_key(), lrs)
-        return new_state, StepMetrics(packed)
+            call_args = (state, superbatch, self._dispatch_key(), lrs,
+                         jnp.asarray(self._poison_scalars(k)))
+            new_state, packed = fn(*call_args)
+            sums = StepMetrics(packed, guarded=True)
+            self._tc_after("guard-scan", (bs, k), fn, call_args,
+                           result=(new_state, sums))
+            return new_state, sums
+        call_args = (state, superbatch, self._dispatch_key(), lrs)
+        new_state, packed = fn(*call_args)
+        sums = StepMetrics(packed)
+        self._tc_after("scan", (bs, k), fn, call_args,
+                       result=(new_state, sums))
+        return new_state, sums
 
     def shard_superbatch(self, superbatch):
         """Place stacked (k, batch, ...) arrays for the scan dispatch: dim 0

@@ -32,6 +32,23 @@ _T1_BUDGET = float(os.environ.get("MXTPU_T1_BUDGET", "870"))
 
 
 @pytest.fixture(autouse=True)
+def _tracecheck_transfer_guard(request):
+    """``tracecheck``-marked tests run under ``jax.transfer_guard
+    ("disallow")`` (docs/static_analysis.md "Transfer-guard interplay"):
+    the runtime complement of the static host-sync lint. Explicit
+    transfers (``jnp.asarray``, ``device_put``, the packed StepMetrics
+    readback) stay legal; an IMPLICIT transfer inside the fused-dispatch
+    hot loop — a numpy array leaking into a jit call, a Python scalar
+    index forcing an H2D — raises immediately, naming the callsite,
+    instead of silently serializing every dispatch."""
+    if request.node.get_closest_marker("tracecheck") is None:
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@pytest.fixture(autouse=True)
 def _pipeline_wall_clock_cap(request):
     """Per-test wall-clock ceiling for ``pipeline``-marked tests."""
     if request.node.get_closest_marker("pipeline") is None:

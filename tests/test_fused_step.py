@@ -209,6 +209,7 @@ def _stacked_batches(k=4, batch=8, seed=11):
     return Xs, ys
 
 
+@pytest.mark.tracecheck  # hot loop under jax.transfer_guard("disallow")
 @pytest.mark.parametrize("momentum", [0.0, 0.9])
 def test_run_steps_matches_sequential(momentum):
     """run_steps(state, sb, k) == K sequential step() calls: params AND the
@@ -279,18 +280,26 @@ def test_run_steps_lr_scheduler_granularity():
             atol=1e-6, rtol=1e-6, err_msg=n)
 
 
+@pytest.mark.tracecheck
 def test_run_steps_no_retrace_across_epochs():
     """Same (batch, k) shape must reuse ONE compiled scan across epochs;
-    different k compiles separately, returning to a seen k reuses it."""
+    different k compiles separately, returning to a seen k reuses it.
+    The whole loop runs inside ``assert_no_retrace`` (the tracecheck
+    cache-key differ) and under ``jax.transfer_guard("disallow")`` via the
+    ``tracecheck`` marker — a retrace OR an implicit host transfer in the
+    dispatch loop fails with the offending argument/callsite named."""
+    from mxnet_tpu.test_utils import assert_no_retrace
     net = _mlp()
     B = 8
     step = TrainStep(net, optimizer="sgd", learning_rate=0.05)
     state = step.init({"data": (B, 10)}, {"softmax_label": (B,)}, seed=1)
 
-    for k in (2, 4, 2, 2, 4):  # "epochs" of varying K
-        Xs, ys = _stacked_batches(k, B, seed=k)
-        state, _ = step.run_steps(state, {"data": jnp.asarray(Xs),
-                                          "softmax_label": jnp.asarray(ys)})
+    with assert_no_retrace(msg="varying-K epochs"):
+        for k in (2, 4, 2, 2, 4):  # "epochs" of varying K
+            Xs, ys = _stacked_batches(k, B, seed=k)
+            state, _ = step.run_steps(
+                state, {"data": jnp.asarray(Xs),
+                        "softmax_label": jnp.asarray(ys)})
     assert set(step._jit_scan) == {(B, 2), (B, 4)}
     for fn in step._jit_scan.values():
         assert fn._cache_size() == 1, "scan retraced for an already-seen K"

@@ -171,7 +171,14 @@ def test_guard_disabled_trace_and_caches_unchanged():
 
     st, _ = s.run_steps(st, dict(sb))
     st, _ = s.run_steps(st, dict(sb), guard=True)
-    st, _ = s.run_steps(st, dict(sb))
+    # the guarded scan holds one program across repeat dispatches — pinned
+    # by the tracecheck cache-key differ, which would name the argument
+    # whose signature drifted if either cache missed
+    from mxnet_tpu.test_utils import assert_no_retrace
+    with assert_no_retrace(s._jit_scan[(B, K)], s._jit_scan_g[(B, K)],
+                           msg="guard on/off toggling"):
+        st, _ = s.run_steps(st, dict(sb), guard=True)
+        st, _ = s.run_steps(st, dict(sb))
     assert set(s._jit_scan) == {(B, K)}
     assert set(s._jit_scan_g) == {(B, K)}
     for f in list(s._jit_scan.values()) + list(s._jit_scan_g.values()):
@@ -373,7 +380,13 @@ def test_rollback_under_dispatch_bulking(tmp_path):
     prefix = str(tmp_path / "ck")
     g = TrainingGuard(patience=1, max_rollbacks=1, lr_factor=0.5)
     faults.inject("guard.loss_spike", nth=2)     # 2nd dispatch observation
-    mod, _ = _guarded_fit(X, y, 4, g, num_epoch=2, prefix=prefix, every=4)
+    # post-rollback resume must redispatch through the SAME compiled scan
+    # (PR-3's no-recompile rollback contract) — the tracecheck differ
+    # names the drifting argument if the reseeded state ever retraces
+    from mxnet_tpu.test_utils import assert_no_retrace
+    with assert_no_retrace(msg="rollback + resume"):
+        mod, _ = _guarded_fit(X, y, 4, g, num_epoch=2, prefix=prefix,
+                              every=4)
     assert g.health.rollbacks == 1
     assert abs(mod._optimizer.lr - 0.05) < 1e-12
     arg, _ = mod.get_params()

@@ -148,10 +148,14 @@ def test_pipelined_vs_eager_fit_bitwise(tmp_path, use_guard, caplog):
 def test_pipelined_jit_cache_keys_unchanged():
     """Pipelining defers the readback; it must not touch what gets
     compiled — jit caches stay keyed (batch, k), guard-off caches stay
-    guard-free."""
+    guard-free, and the whole pipelined fit (multi-epoch, epoch tails
+    included) never retraces a seen program (tracecheck cache-key differ
+    names the drifting argument if it ever does)."""
+    from mxnet_tpu.test_utils import assert_no_retrace
     X, y = _toy_data()
     a, _, _ = _fit(X, y, depth=0)
-    b, _, _ = _fit(X, y, depth=2)
+    with assert_no_retrace(msg="pipelined fit"):
+        b, _, _ = _fit(X, y, depth=2)
     assert sorted(a._fused._jit_scan) == sorted(b._fused._jit_scan)
     assert not a._fused._jit_scan_g and not b._fused._jit_scan_g
 
