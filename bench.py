@@ -25,6 +25,14 @@ BENCH_STEPS_PER_DISPATCH (default 1; >=2 enables the steady-state bulked
 mode: K steps per lax.scan dispatch over a device-resident superbatch with
 metrics read back once per K — docs/perf.md "Dispatch bulking").
 
+BENCH_SERVE=1 switches to the serving latency bench (docs/serving.md):
+drive the dynamic batcher over the AOT shape-bucketed engine at a target
+QPS with open-loop arrivals and report request latency p50/p99 plus
+achieved throughput as one JSON line (the BENCH_serve_rNN.json number).
+Knobs: BENCH_SERVE_MODEL (mlp|lenet, default mlp), BENCH_SERVE_QPS
+(default 200), BENCH_SERVE_REQS (default 400), BENCH_SERVE_CLIENTS
+(default 4), plus the MXTPU_SERVE_* batcher knobs (docs/env_var.md).
+
 BENCH_HOST_OVERHEAD=1 switches to the host-overhead mode (docs/perf.md
 "Host off the critical path"): a full Module.fit loop with checkpointing
 enabled, swept over BENCH_CKPT_CADENCES (default "8,16"), measuring
@@ -161,6 +169,116 @@ def host_overhead_main():
         # means a config retraced a seen program (docs/static_analysis.md)
         "retraces": tracecheck.retrace_count(),
         "sweep": sweep,
+    }
+    print(json.dumps(out))
+
+
+def _serve_model():
+    """Build (engine kwargs) for the serving bench: symbol + random
+    params at deploy-realistic shapes (weights don't affect latency)."""
+    from mxnet_tpu import models
+    name = os.environ.get("BENCH_SERVE_MODEL", "mlp")
+    if name == "lenet":
+        sym = models.lenet(num_classes=10)
+        shape = (1, 28, 28)
+    elif name == "mlp":
+        sym = models.mlp(num_classes=10, hidden=(128,))
+        shape = (64,)
+    else:
+        raise SystemExit("BENCH_SERVE_MODEL must be mlp|lenet, got %r"
+                         % name)
+    probe = {"data": (2,) + shape, "softmax_label": (2,)}
+    arg_shapes, _, _ = sym.infer_shape(
+        **{k: v for k, v in probe.items()
+           if k in sym.list_arguments()})
+    rs = np.random.default_rng(0)
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        params[n] = (rs.normal(size=s) * 0.1).astype(np.float32)
+    return name, sym, params, shape
+
+
+def serve_main():
+    """Serving latency bench: open-loop arrivals at a target QPS through
+    the dynamic batcher; one JSON line with p50/p99 latency and achieved
+    throughput (docs/serving.md "Latency bench")."""
+    import threading
+    from mxnet_tpu import serving, tracecheck
+
+    qps = float(os.environ.get("BENCH_SERVE_QPS", "200"))
+    nreq = int(os.environ.get("BENCH_SERVE_REQS", "400"))
+    nclients = int(os.environ.get("BENCH_SERVE_CLIENTS", "4"))
+    name, sym, params, shape = _serve_model()
+
+    eng = serving.ServingEngine(sym, params, {"data": shape})
+    batcher = serving.Batcher(eng)
+    rs = np.random.default_rng(1)
+    x1 = rs.normal(size=(1,) + shape).astype(np.float32)
+    batcher.infer({"data": x1})           # warm the smallest bucket path
+
+    latencies = []
+    errors = []
+    lock = threading.Lock()
+    interval = 1.0 / qps
+    t0 = time.perf_counter() + 0.05
+
+    def client(cid):
+        # open-loop: request i is DUE at t0 + i*interval regardless of
+        # how long earlier requests took — queueing delay shows up in the
+        # measured latency instead of silently lowering the offered load
+        for i in range(cid, nreq, nclients):
+            due = t0 + i * interval
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t_start = time.perf_counter()
+            try:
+                batcher.infer({"data": x1})
+                dt = time.perf_counter() - t_start
+                with lock:
+                    latencies.append(dt)
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(nclients)]
+    wall0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - wall0
+    batcher.close()
+    if not latencies:
+        raise RuntimeError("serving bench completed no requests: %s"
+                           % errors[:3])
+    lat_ms = np.asarray(latencies) * 1e3
+    findings = tracecheck.unsuppressed(
+        tracecheck.check_registered(match=eng.name + "/"))
+    out = {
+        "metric": "serve_%s_latency_qps%g" % (name, qps),
+        "value": round(float(np.percentile(lat_ms, 99)), 3),
+        "unit": "ms_p99",
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "mean_ms": round(float(lat_ms.mean()), 3),
+        "throughput_rps": round(len(latencies) / wall, 2),
+        "qps_target": qps,
+        "completed": len(latencies),
+        "failed": len(errors),
+        "buckets": list(eng.buckets),
+        "batches": eng.health.batches,
+        "avg_batch": round(eng.health.examples
+                           / max(1, eng.health.batches), 2),
+        "padded_frac": round(eng.health.padded
+                             / max(1, eng.health.examples
+                                   + eng.health.padded), 4),
+        # the serving program set must stay lint-clean while under load
+        "tracecheck_findings": len(findings),
+        "retraces": tracecheck.retrace_count(),
     }
     print(json.dumps(out))
 
@@ -317,7 +435,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_HOST_OVERHEAD", "").strip() not in ("", "0"):
+    if os.environ.get("BENCH_SERVE", "").strip() not in ("", "0"):
+        serve_main()
+    elif os.environ.get("BENCH_HOST_OVERHEAD", "").strip() not in ("", "0"):
         host_overhead_main()
     else:
         main()

@@ -17,6 +17,9 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # static lints over the model zoo's compiled step programs
 # (docs/static_analysis.md; tier-1 keeps a faster 2-model smoke)
 ./ci/tracecheck.sh
+# serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
+# tracecheck findings on the serving program set (docs/serving.md)
+./ci/serve.sh
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 # chip stage: hard convergence gates + the ImageNet recipe compile-check
 # (uses the real TPU when attached; tools default to the ambient platform).

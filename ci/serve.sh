@@ -1,0 +1,38 @@
+#!/bin/sh
+# CI gate: serving-tier smoke (docs/serving.md). For mlp and lenet, AOT-
+# compile a two-bucket engine, drive the dynamic batcher at low QPS on CPU
+# through bench.py's BENCH_SERVE mode, and assert (a) zero unsuppressed
+# tracecheck findings on the serving program set, (b) every request
+# completed, (c) p99 latency under a deliberately generous cap — this is a
+# "the serving tier works and stays lint-clean" gate, not a perf gate
+# (BENCH_serve_rNN.json tracks the number).
+#
+# Usage: ci/serve.sh [p99_cap_ms]   (default 2000)
+set -e
+cd "$(dirname "$0")/.."
+CAP_MS="${1:-2000}"
+for MODEL in mlp lenet; do
+    echo "ci/serve.sh: $MODEL (buckets 1,8; qps 50)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+        BENCH_SERVE=1 BENCH_SERVE_MODEL="$MODEL" \
+        BENCH_SERVE_QPS=50 BENCH_SERVE_REQS=60 BENCH_SERVE_CLIENTS=3 \
+        MXTPU_SERVE_BUCKETS="1,8" \
+        python bench.py | tail -n 1 | CAP_MS="$CAP_MS" python -c '
+import json, os, sys
+r = json.loads(sys.stdin.readline())
+cap = float(os.environ["CAP_MS"])
+bad = []
+if r["tracecheck_findings"]:
+    bad.append("tracecheck findings on the serving program set: %d"
+               % r["tracecheck_findings"])
+if r["failed"]:
+    bad.append("%d requests failed" % r["failed"])
+if r["p99_ms"] > cap:
+    bad.append("p99 %.1f ms over the %.0f ms smoke cap" % (r["p99_ms"], cap))
+if bad:
+    sys.exit("ci/serve.sh FAIL (%s): %s" % (r["metric"], "; ".join(bad)))
+print("  %s: p50 %.2f ms, p99 %.2f ms, %.1f req/s, findings 0"
+      % (r["metric"], r["p50_ms"], r["p99_ms"], r["throughput_rps"]))
+'
+done
+echo "serve smoke PASS"

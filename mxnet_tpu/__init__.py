@@ -57,6 +57,7 @@ from . import kvstore_server
 from .kvstore_server import _init_distributed as tools_init_distributed
 from . import predictor
 from .predictor import Predictor
+from . import serving
 # refresh op-function namespaces so late registrations (Custom) appear
 ndarray._init_ndarray_module()
 symbol._init_symbol_module()

@@ -92,6 +92,16 @@ def env_bool(name):
         not in ("", "0", "false", "off", "no")
 
 
+def env_str(name, default=""):
+    """Read an env var as a stripped string knob (MXTPU_SERVE_* readers
+    share this); unset or blank means ``default``."""
+    import os
+    v = os.environ.get(name)
+    if v is None or v.strip() == "":
+        return default
+    return v.strip()
+
+
 def attr_str(v, default=None):
     if v is _NULL or v is None:
         return default

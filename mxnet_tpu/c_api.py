@@ -405,8 +405,12 @@ def _pred_create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
         params = {}
     shapes = {k: tuple(int(d) for d in s)
               for k, s in zip(input_keys, input_shapes)}
+    # legacy contract: a NULL/empty param blob means "uninitialized
+    # predictor" (zero weights) — keep it; a NON-empty blob with missing
+    # keys is a broken deploy and raises (predictor.check_missing_params)
     pred = Predictor(symbol_json, params, shapes, ctx=ctx,
-                     output_names=output_names)
+                     output_names=output_names,
+                     allow_missing=not param_bytes)
     pred._pending = {}
     return _new_handle(pred)
 
@@ -436,6 +440,10 @@ def MXPredReshape(handle, input_keys, input_shapes):
     import copy as _copy
     pred = _get(handle)
     new = _copy.copy(pred)     # shares symbol/params; gets its own executor
+    from collections import OrderedDict as _OD
+    new._exec_cache = _OD()    # executors are NOT shared across handles:
+    #                            two handles at one shape must keep their
+    #                            own input placeholders (set-input isolation)
     shapes = {k: tuple(int(d) for d in s)
               for k, s in zip(input_keys, input_shapes)}
     new.reshape(shapes)

@@ -38,6 +38,14 @@ site                         where it fires
                              the loss the divergence watcher sees
 ``guard.param_nan``          at checkpoint save — forces the manifest's
                              known-good bit off (params "went non-finite")
+``serve.enqueue_drop``       per ``serving.Batcher.submit`` — ``"drop"``
+                             rejects the request with
+                             ``ServingOverloadedError`` (back-pressure
+                             shed at the edge)
+``serve.decode_die``         top of every ``serving.DecodeLoop`` iteration
+                             — ``"die"`` (or any raising kind) kills the
+                             loop thread, which sheds every in-flight and
+                             queued sequence with ``ServingClosedError``
 ===========================  ==============================================
 
 Rule kinds:

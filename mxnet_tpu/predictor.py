@@ -4,9 +4,13 @@ src/c_api/c_predict_api.cc, 334 LoC; amalgamation's MXNET_PREDICT_ONLY build).
 Inference-only API over a saved checkpoint: load symbol JSON + params, bind
 once, ``forward`` repeatedly. The reference ships this as a separate minimal
 C API for mobile/embedded; here it is a thin class whose jitted forward is
-the deployable artifact (export via jax.jit / AOT lowering).
+the deployable artifact. The production serving tier —  AOT-compiled shape
+buckets, dynamic batching, continuous decode — builds on the same helpers
+and lives in :mod:`mxnet_tpu.serving` (docs/serving.md).
 """
 from __future__ import annotations
+
+from collections import OrderedDict
 
 import numpy as np
 
@@ -53,78 +57,108 @@ def _strip_loss_heads(symbol):
     return Symbol(new_outputs) if changed else symbol
 
 
+def load_symbol(symbol_json_or_file):
+    """Accept a Symbol, a JSON string, or a path to a -symbol.json file
+    (shared by Predictor and serving.ServingEngine)."""
+    if isinstance(symbol_json_or_file, str):
+        if symbol_json_or_file.lstrip().startswith("{"):
+            return sym.load_json(symbol_json_or_file)
+        return sym.load(symbol_json_or_file)
+    return symbol_json_or_file
+
+
+def load_param_dict(param_file_or_dict):
+    """Split a saved-params file (or an already-loaded dict, with or without
+    ``arg:``/``aux:`` prefixes) into (arg_params, aux_params)."""
+    if isinstance(param_file_or_dict, str):
+        loaded = nd.load(param_file_or_dict)
+    else:
+        loaded = param_file_or_dict
+    arg_params = {}
+    aux_params = {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def pick_partial_outputs(symbol, output_names):
+    """Partial-output binding: group only the requested internal heads
+    (ref: MXPredCreatePartialOut, c_predict_api.h:92-102)."""
+    internals = symbol.get_internals()
+    avail = internals.list_outputs()
+    picked = []
+    for key in output_names:
+        cand = key if key in avail else key + "_output"
+        if cand not in avail:
+            raise MXNetError(
+                "partial output %r not found (have e.g. %s)"
+                % (key, avail[-5:]))
+        picked.append(internals[avail.index(cand)])
+    return sym.Group(picked)
+
+
+def check_missing_params(symbol, input_names, arg_params, aux_params,
+                         who="Predictor"):
+    """Raise an MXNetError naming every parameter/auxiliary state the
+    loaded dict does NOT cover. A typo'd or truncated key must fail loudly:
+    silently zero-filling a weight serves garbage predictions."""
+    missing = [n for n in symbol.list_arguments()
+               if n not in input_names and n not in arg_params
+               # a loss head outside _LOSS_HEADS keeps its label variable
+               # in list_arguments(); labels are inputs, not checkpoint
+               # parameters (the "<name>_label" default-naming convention)
+               and not n.endswith("_label")]
+    missing += ["aux:" + n for n in symbol.list_auxiliary_states()
+                if n not in aux_params]
+    if missing:
+        raise MXNetError(
+            "%s: checkpoint is missing parameter(s) %s — a stale or "
+            "mismatched params file would serve garbage predictions "
+            "(pass allow_missing=True to zero-fill deliberately)"
+            % (who, sorted(missing)))
+
+
 class Predictor(object):
     def __init__(self, symbol_json_or_file, param_file_or_dict, input_shapes,
-                 ctx=None, output_names=None):
+                 ctx=None, output_names=None, allow_missing=False):
         ctx = ctx or current_context()
-        if isinstance(symbol_json_or_file, str):
-            if symbol_json_or_file.lstrip().startswith("{"):
-                self._symbol = sym.load_json(symbol_json_or_file)
-            else:
-                self._symbol = sym.load(symbol_json_or_file)
-        else:
-            self._symbol = symbol_json_or_file
-        self._symbol = _strip_loss_heads(self._symbol)
+        self._symbol = _strip_loss_heads(load_symbol(symbol_json_or_file))
         if output_names:
-            # partial-output predictor: bind only the requested heads
-            # (ref: MXPredCreatePartialOut, c_predict_api.h:92-102)
-            internals = self._symbol.get_internals()
-            avail = internals.list_outputs()
-            picked = []
-            for key in output_names:
-                cand = key if key in avail else key + "_output"
-                if cand not in avail:
-                    raise MXNetError(
-                        "partial output %r not found (have e.g. %s)"
-                        % (key, avail[-5:]))
-                picked.append(internals[avail.index(cand)])
-            self._symbol = sym.Group(picked)
-        if isinstance(param_file_or_dict, str):
-            loaded = nd.load(param_file_or_dict)
-        else:
-            loaded = param_file_or_dict
-        arg_params = {}
-        aux_params = {}
-        for k, v in loaded.items():
-            if k.startswith("arg:"):
-                arg_params[k[4:]] = v
-            elif k.startswith("aux:"):
-                aux_params[k[4:]] = v
-            else:
-                arg_params[k] = v
-
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
-        arg_names = self._symbol.list_arguments()
-        args = {}
-        for name, shape in zip(arg_names, arg_shapes):
-            if name in arg_params:
-                args[name] = arg_params[name]
-            else:
-                args[name] = nd.zeros(shape)
-        aux = {}
-        for name, shape in zip(self._symbol.list_auxiliary_states(),
-                               aux_shapes):
-            aux[name] = aux_params.get(name, nd.zeros(shape))
+            self._symbol = pick_partial_outputs(self._symbol, output_names)
+        arg_params, aux_params = load_param_dict(param_file_or_dict)
+        if not allow_missing:
+            check_missing_params(self._symbol, set(input_shapes),
+                                 arg_params, aux_params)
         self._input_names = list(input_shapes.keys())
         self._ctx = ctx
         self._arg_params = arg_params
         self._aux_params = aux_params
-        self._executor = self._symbol.bind(ctx, args, aux_states=aux)
+        # executors cached by the full input-shape tuple: alternating batch
+        # sizes through reshape() reuse their executor instead of rebinding
+        # (and re-jitting) on every flip — the serving batcher depends on
+        # it. LRU-bounded: unquantized request sizes must not pin one
+        # compiled program per distinct batch size forever.
+        self._exec_cache = OrderedDict()
+        self._executor = self._bind(
+            {k: tuple(v) for k, v in input_shapes.items()})
 
-    def reshape(self, input_shapes):
-        """Rebind for new input shapes, keeping the loaded parameters —
-        the MXPredReshape capability (a predictor serving variable batch
-        sizes without reloading weights). Inputs not named keep their
-        current shapes (the reference allows partial reshape). Returns
-        self."""
-        full = {n: tuple(self._executor.arg_dict[n].shape)
-                for n in self._input_names}
-        unknown = set(input_shapes) - set(full)
-        if unknown:
-            raise MXNetError("reshape: unknown inputs %s (have %s)"
-                             % (sorted(unknown), self._input_names))
-        full.update({k: tuple(v) for k, v in input_shapes.items()})
-        input_shapes = full
+    def _shape_key(self, input_shapes):
+        return tuple(sorted((n, tuple(s)) for n, s in input_shapes.items()))
+
+    #: executor-cache LRU bound (distinct input-shape tuples kept alive)
+    _EXEC_CACHE_CAP = 16
+
+    def _bind(self, input_shapes):
+        key = self._shape_key(input_shapes)
+        cached = self._exec_cache.get(key)
+        if cached is not None:
+            self._exec_cache.move_to_end(key)
+            return cached
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
         args = {}
         for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
@@ -132,8 +166,9 @@ class Predictor(object):
                 p = self._arg_params[name]
                 if tuple(p.shape) != tuple(shape):
                     raise MXNetError(
-                        "reshape changes parameter %s: %s -> %s (only input "
-                        "shapes may change)" % (name, p.shape, shape))
+                        "bind changes parameter %s: %s -> %s (only input "
+                        "shapes may change)" % (name, tuple(p.shape),
+                                                tuple(shape)))
                 args[name] = p
             else:
                 args[name] = nd.zeros(shape)
@@ -144,13 +179,40 @@ class Predictor(object):
                 a = self._aux_params[name]
                 if tuple(a.shape) != tuple(shape):
                     raise MXNetError(
-                        "reshape changes auxiliary state %s: %s -> %s (only "
-                        "input shapes may change)" % (name, a.shape, shape))
+                        "bind changes auxiliary state %s: %s -> %s (only "
+                        "input shapes may change)" % (name, tuple(a.shape),
+                                                      tuple(shape)))
                 aux[name] = a
             else:
                 aux[name] = nd.zeros(shape)
-        self._input_names = list(input_shapes.keys())
-        self._executor = self._symbol.bind(self._ctx, args, aux_states=aux)
+        executor = self._symbol.bind(self._ctx, args, aux_states=aux)
+        self._exec_cache[key] = executor
+        while len(self._exec_cache) > self._EXEC_CACHE_CAP:
+            self._exec_cache.popitem(last=False)
+        return executor
+
+    def reshape(self, input_shapes):
+        """Rebind for new input shapes, keeping the loaded parameters —
+        the MXPredReshape capability (a predictor serving variable batch
+        sizes without reloading weights). Inputs not named keep their
+        current shapes (the reference allows partial reshape). Executors
+        are cached by the full input-shape tuple, so flipping between a
+        set of batch sizes binds (and compiles) each shape once. Returns
+        self."""
+        full = {n: tuple(self._executor.arg_dict[n].shape)
+                for n in self._input_names}
+        unknown = set(input_shapes) - set(full)
+        if unknown:
+            raise MXNetError("reshape: unknown inputs %s (have %s)"
+                             % (sorted(unknown), self._input_names))
+        full.update({k: tuple(v) for k, v in input_shapes.items()})
+        try:
+            self._executor = self._bind(full)
+        except MXNetError as e:
+            # keep the historical reshape error contract
+            raise MXNetError(str(e).replace("bind changes",
+                                            "reshape changes"))
+        self._input_names = list(full.keys())
         return self
 
     def forward(self, **inputs):

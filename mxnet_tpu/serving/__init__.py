@@ -1,0 +1,32 @@
+"""mxnet_tpu.serving — the production inference tier (docs/serving.md).
+
+Three layers over the standalone :class:`~mxnet_tpu.predictor.Predictor`:
+
+* :class:`ServingEngine` — the stripped-head forward AOT-compiled for a
+  fixed set of batch-size buckets at load time, with serialized-executable
+  export/import for cold-start-free deploys; every program registers with
+  :mod:`mxnet_tpu.tracecheck`.
+* :class:`Batcher` — a request queue + batching thread coalescing
+  concurrent ``infer()`` calls into the smallest covering bucket, with
+  max-latency / max-batch / deadline / back-pressure knobs
+  (``MXTPU_SERVE_*``).
+* :class:`DecodeLoop` — slot-based continuous batching for the
+  transformer LM: the KV cache is donated device state stepped by one
+  compiled decode body; sequences join and leave mid-stream.
+
+Degradation is counted in :class:`ServingHealth` (process-global aggregate
+``serving.SERVING_HEALTH``), mirroring ``io.DATA_HEALTH`` /
+``guard.TRAINING_HEALTH``.
+"""
+from .health import ServingHealth, SERVING_HEALTH
+from .engine import ServingEngine, default_buckets
+from .batcher import (Batcher, ServingError, ServingDeadlineError,
+                      ServingOverloadedError, ServingClosedError)
+from .decode import DecodeLoop, GenerateFuture
+
+__all__ = [
+    "ServingEngine", "Batcher", "DecodeLoop", "GenerateFuture",
+    "ServingHealth", "SERVING_HEALTH", "default_buckets",
+    "ServingError", "ServingDeadlineError", "ServingOverloadedError",
+    "ServingClosedError",
+]

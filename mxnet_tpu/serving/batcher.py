@@ -1,0 +1,297 @@
+"""Dynamic request batcher: coalesce concurrent ``infer`` calls into
+shape-bucketed engine dispatches (docs/serving.md).
+
+The training side amortizes host overhead by bulking K steps into one
+dispatch (docs/perf.md); the serving side amortizes it by bulking K
+*requests* into one padded bucket. A single batching thread drains a
+bounded queue, coalesces requests until the smallest covering bucket is
+full or ``max_latency`` has elapsed since the oldest queued request, pads,
+dispatches through the AOT engine, and splits the result rows back per
+request.
+
+Knobs (constructor arg > ``MXTPU_SERVE_*`` env > default):
+
+===========================  =============================================
+``MXTPU_SERVE_MAX_BATCH``    request-coalescing ceiling (default: the
+                             engine's largest bucket)
+``MXTPU_SERVE_MAX_LATENCY_MS`` how long a dispatch may wait for co-riders
+                             once a request is queued (default 5 ms)
+``MXTPU_SERVE_QUEUE``        bounded queue depth — back-pressure surfaces
+                             as :class:`ServingOverloadedError` instead of
+                             unbounded memory growth (default 256)
+``MXTPU_SERVE_DEADLINE_MS``  default per-request deadline; a request that
+                             cannot be dispatched in time fails with
+                             :class:`ServingDeadlineError` (default 1000)
+===========================  =============================================
+
+Fault sites (docs/robustness.md): ``serve.enqueue_drop`` fires per
+submission — the ``drop`` kind rejects the request with a clear error (and
+``raise``/``transient`` kinds propagate); a batch-thread death sheds every
+queued and in-flight request with :class:`ServingClosedError` instead of
+hanging callers.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError, env_float
+from .health import ServingHealth, SERVING_HEALTH
+
+
+class ServingError(MXNetError):
+    """Base class for serving-tier request failures."""
+
+
+class ServingDeadlineError(ServingError):
+    """The request's deadline passed before it could be served."""
+
+
+class ServingOverloadedError(ServingError):
+    """The bounded request queue is full (back-pressure: shed at the edge
+    rather than queue without bound)."""
+
+
+class ServingClosedError(ServingError):
+    """The batcher/loop is closed (or died) — the request was shed."""
+
+
+class _Request(object):
+    __slots__ = ("inputs", "n", "deadline", "event", "result", "error")
+
+    def __init__(self, inputs, n, deadline):
+        self.inputs = inputs
+        self.n = n
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+    def fail(self, exc):
+        self.error = exc
+        self.event.set()
+
+    def fulfill(self, outs):
+        self.result = outs
+        self.event.set()
+
+
+class Batcher(object):
+    """Request-coalescing front end over a :class:`ServingEngine`.
+
+    ``infer(inputs)`` blocks the calling thread until its rows come back
+    (or its deadline passes); concurrent callers ride the same padded
+    bucket dispatch. ``start=False`` builds the batcher with the batching
+    thread parked — tests enqueue a deterministic backlog, then
+    :meth:`start` coalesces it into one dispatch.
+    """
+
+    def __init__(self, engine, max_batch=None, max_latency_ms=None,
+                 queue_size=None, deadline_ms=None, health=None, start=True):
+        self.engine = engine
+        self.max_batch = int(max_batch if max_batch is not None
+                             else env_float("MXTPU_SERVE_MAX_BATCH",
+                                            engine.max_batch))
+        if self.max_batch < 1 or self.max_batch > engine.max_batch:
+            raise MXNetError(
+                "Batcher: max_batch %d outside the engine's buckets "
+                "(largest %d)" % (self.max_batch, engine.max_batch))
+        self.max_latency = (max_latency_ms if max_latency_ms is not None
+                            else env_float("MXTPU_SERVE_MAX_LATENCY_MS",
+                                           5.0)) / 1e3
+        self.default_deadline = (
+            deadline_ms if deadline_ms is not None
+            else env_float("MXTPU_SERVE_DEADLINE_MS", 1000.0)) / 1e3
+        qsize = int(queue_size if queue_size is not None
+                    else env_float("MXTPU_SERVE_QUEUE", 256))
+        self._queue = queue.Queue(maxsize=qsize)
+        self._carry = None      # request popped but not fitting the batch
+        self._closed = False
+        self.health = health or ServingHealth(parent=SERVING_HEALTH)
+        self._thread = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._closed = False
+            self._thread = threading.Thread(target=self._run,
+                                            name="mxtpu-serve-batcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Stop the batching thread and shed everything still queued."""
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._shed(ServingClosedError("batcher closed"))
+
+    def _shed(self, exc):
+        shed = 0
+        if self._carry is not None:
+            self._carry.fail(exc)
+            self._carry = None
+            shed += 1
+        while True:
+            try:
+                self._queue.get_nowait().fail(exc)
+                shed += 1
+            except queue.Empty:
+                break
+        if shed:
+            self.health.record_shed(shed, exc)
+
+    # ------------------------------------------------------------------
+    def infer(self, inputs, deadline_ms=None):
+        """Blocking inference: dict name -> (n, ...) array; returns the
+        engine's output list sliced to this request's n rows."""
+        req = self.submit(inputs, deadline_ms=deadline_ms)
+        return self.wait(req)
+
+    def submit(self, inputs, deadline_ms=None):
+        """Enqueue without blocking on the result; returns the request
+        handle for :meth:`wait`."""
+        from .. import faults as _faults
+        if self._closed:
+            raise ServingClosedError("batcher is closed")
+        if self._thread is not None and not self._thread.is_alive():
+            raise ServingClosedError("batching thread died")
+        n = None
+        host = {}
+        for name in self.engine._input_names:
+            if name not in inputs:
+                raise MXNetError("submit: missing input %r (need %s)"
+                                 % (name, self.engine._input_names))
+            v = np.asarray(inputs[name], self.engine._input_dtypes[name])
+            # reject a malformed request HERE, alone — once coalesced, a bad
+            # shape would fail every innocent co-rider in its batch
+            if tuple(v.shape[1:]) != self.engine._input_shapes[name]:
+                raise MXNetError(
+                    "submit: input %r per-example shape %s != %s"
+                    % (name, tuple(v.shape[1:]),
+                       self.engine._input_shapes[name]))
+            if n is None:
+                n = v.shape[0]
+            elif v.shape[0] != n:
+                raise MXNetError("submit: inputs disagree on batch size")
+            host[name] = v
+        if n == 0:
+            raise MXNetError("submit: empty request")
+        if n > self.max_batch:
+            raise MXNetError(
+                "submit: request of %d rows exceeds max_batch %d (chunk "
+                "it, or call engine.infer directly)" % (n, self.max_batch))
+        act = _faults.fire("serve.enqueue_drop")
+        if act == "drop":
+            err = ServingOverloadedError(
+                "request dropped at enqueue (injected serve.enqueue_drop)")
+            self.health.record_dropped(err)
+            raise err
+        deadline = time.monotonic() + (
+            (deadline_ms / 1e3) if deadline_ms is not None
+            else self.default_deadline)
+        req = _Request(host, n, deadline)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            err = ServingOverloadedError(
+                "request queue full (%d waiting) — the serving tier is "
+                "saturated; shed at the edge" % self._queue.maxsize)
+            self.health.record_dropped(err)
+            raise err
+        self.health.record_request()
+        return req
+
+    def wait(self, req):
+        """Block until ``req`` resolves; raises its error if it failed."""
+        while not req.event.wait(0.05):
+            if (self._thread is not None and not self._thread.is_alive()
+                    and not req.event.is_set()):
+                req.fail(ServingClosedError(
+                    "batching thread died with the request in flight"))
+                break
+            if time.monotonic() > req.deadline and not req.event.is_set():
+                # the batcher also expires queued requests; this covers a
+                # request stuck behind a long-running dispatch
+                req.fail(ServingDeadlineError(
+                    "deadline passed while waiting for dispatch"))
+                self.health.record_expired(req.error)
+                break
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ------------------------------------------------------------------
+    def _next_request(self, timeout):
+        if self._carry is not None:
+            req, self._carry = self._carry, None
+            return req
+        try:
+            return self._queue.get(timeout=max(0.0, timeout))
+        except queue.Empty:
+            return None
+
+    def _run(self):
+        while not self._closed:
+            req = self._next_request(0.05)
+            if req is None:
+                continue
+            now = time.monotonic()
+            if now > req.deadline:
+                req.fail(ServingDeadlineError("expired in queue"))
+                self.health.record_expired(req.error)
+                continue
+            batch = [req]
+            total = req.n
+            flush_at = now + self.max_latency
+            while total < self.max_batch and not self._closed:
+                remaining = flush_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                nxt = self._next_request(remaining)
+                if nxt is None:
+                    break
+                if time.monotonic() > nxt.deadline:
+                    nxt.fail(ServingDeadlineError("expired in queue"))
+                    self.health.record_expired(nxt.error)
+                    continue
+                if total + nxt.n > self.max_batch:
+                    self._carry = nxt
+                    break
+                batch.append(nxt)
+                total += nxt.n
+            self._dispatch(batch, total)
+        # closing: anything still queued is shed by close()
+
+    def _dispatch(self, batch, total):
+        names = self.engine._input_names
+        try:
+            if len(batch) == 1:
+                stacked = batch[0].inputs
+            else:
+                stacked = {n: np.concatenate([r.inputs[n] for r in batch])
+                           for n in names}
+            outs = self.engine.infer(stacked)
+        except Exception as e:
+            for r in batch:
+                r.fail(e)
+            self.health.record_error(e)
+            return
+        # split result rows back per request (outputs may carry a
+        # rows-per-example factor, e.g. the LM's (batch*seq, vocab) head)
+        offset = 0
+        for r in batch:
+            rows = []
+            for o, f in zip(outs, self.engine._out_row_factor):
+                if f:
+                    rows.append(o[offset * f:(offset + r.n) * f])
+                else:
+                    rows.append(o)
+            r.fulfill(rows)
+            offset += r.n

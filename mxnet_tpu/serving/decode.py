@@ -1,0 +1,371 @@
+"""Continuous-batching decode loop for the transformer LM
+(docs/serving.md "Decode loop").
+
+Autoregressive serving is a different animal from batch inference: each
+sequence wants ONE token per model pass, sequences finish at different
+times, and throughput comes from keeping every batch slot busy. This loop
+is the standard continuous-batching shape (the Gemma-on-TPU serving
+comparison, arXiv:2605.25645; Orca-style slot scheduling) on the donated
+dispatch substrate PR 1/PR 4 built for training:
+
+* the KV cache is DEVICE STATE, donated across steps — the decode body is
+  one AOT-compiled program ``(cache, params, tokens, pos) -> (cache,
+  logits)`` whose cache buffers are reused in place, exactly like the train
+  step's donated parameter state;
+* sequences occupy SLOTS: a new request joins any free slot mid-stream
+  (its prompt is teacher-forced through the same decode body, one token
+  per step, overwriting whatever the retired occupant left in the cache —
+  positions past ``pos`` are masked, so stale rows are unreachable);
+* the host only supplies next tokens and reads back logits (one small
+  readback per step — the irreducible serving analog of the K-step metric
+  readback).
+
+Greedy decoding through this loop is token-for-token identical to full
+re-forward decoding through the AOT engine (tests/test_serving.py parity).
+
+Fault site ``serve.decode_die`` fires at the top of every loop iteration;
+the ``die`` kind (or any raising kind) kills the loop thread, which SHEDS
+every in-flight and queued sequence with :class:`ServingClosedError` —
+callers get a clear error, never a hang.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from .batcher import ServingClosedError
+from .health import ServingHealth, SERVING_HEALTH
+
+
+def _ln(x, gamma, beta):
+    import jax
+    import jax.numpy as jnp
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + jnp.float32(1e-5)) * gamma + beta
+
+
+def _build_decode_fn(num_layers, num_heads):
+    """The decode body: one token per slot through every layer, reading
+    and writing the (layers, slots, heads, max_len, head_dim) KV cache.
+    Matches models/transformer.py op-for-op (pre-LN blocks, qkv packing,
+    1/sqrt(d) scaling) so greedy decode agrees with the full forward."""
+    import jax.numpy as jnp
+    import jax
+
+    def decode_fn(cache, params, tokens, pos):
+        ck, cv = cache["k"], cache["v"]
+        nslots = tokens.shape[0]
+        x = (params["tok_embed_weight"][tokens]
+             + params["pos_embed_weight"][pos])
+        embed = x.shape[1]
+        d = embed // num_heads
+        scale = jnp.float32(1.0 / float(np.sqrt(d)))
+        sidx = jnp.arange(nslots)
+        maxlen = ck.shape[3]
+        tmask = jnp.arange(maxlen)[None, None, :] <= pos[:, None, None]
+        neg = jnp.float32(-1e30)
+        for i in range(num_layers):
+            pre = "layer%d" % i
+            a = _ln(x, params[pre + "_ln1_gamma"], params[pre + "_ln1_beta"])
+            qkv = a @ params[pre + "_attn_qkv_weight"].T \
+                + params[pre + "_attn_qkv_bias"]
+            qkv = qkv.reshape(nslots, 3, num_heads, d)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]     # (slots, H, D)
+            ck = ck.at[i, sidx, :, pos, :].set(k)
+            cv = cv.at[i, sidx, :, pos, :].set(v)
+            s = jnp.einsum("shd,shtd->sht", q, ck[i]) * scale
+            s = jnp.where(tmask, s, neg)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("sht,shtd->shd", w, cv[i]).reshape(nslots, embed)
+            o = o @ params[pre + "_attn_out_weight"].T \
+                + params[pre + "_attn_out_bias"]
+            x = x + o
+            f = _ln(x, params[pre + "_ln2_gamma"], params[pre + "_ln2_beta"])
+            f = jnp.maximum(
+                f @ params[pre + "_ffn_fc1_weight"].T
+                + params[pre + "_ffn_fc1_bias"], jnp.float32(0.0))
+            f = f @ params[pre + "_ffn_fc2_weight"].T \
+                + params[pre + "_ffn_fc2_bias"]
+            x = x + f
+        x = _ln(x, params["final_ln_gamma"], params["final_ln_beta"])
+        logits = x @ params["lm_head_weight"].T + params["lm_head_bias"]
+        return {"k": ck, "v": cv}, logits
+
+    return decode_fn
+
+
+class GenerateFuture(object):
+    """Handle for one in-flight sequence; :meth:`result` blocks."""
+
+    __slots__ = ("prompt", "max_new", "event", "tokens", "error", "_loop")
+
+    def __init__(self, loop, prompt, max_new):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.event = threading.Event()
+        self.tokens = None
+        self.error = None
+        self._loop = loop
+
+    def done(self):
+        return self.event.is_set()
+
+    def result(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self.event.wait(0.05):
+            # a future enqueued in the generate()/close() race window is on
+            # a queue nothing will ever drain — fail it here rather than
+            # spin forever (dead covers crashes; _closed/liveness cover a
+            # clean close that raced our enqueue)
+            stopped = (self._loop.dead is not None or self._loop._closed
+                       or not self._loop._thread.is_alive())
+            if stopped and not self.event.is_set():
+                self.error = ServingClosedError(
+                    "decode loop died with the sequence in flight: %s"
+                    % (self._loop.dead,)
+                    if self._loop.dead is not None else
+                    "decode loop closed with the sequence unserved")
+                self.event.set()
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise MXNetError("generate: timed out after %.1fs"
+                                 % timeout)
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+
+class _Slot(object):
+    __slots__ = ("fut", "pending", "pos", "next_token", "emitted")
+
+    def __init__(self, fut):
+        self.fut = fut
+        self.pending = list(fut.prompt)   # prompt tokens still to feed
+        self.pos = 0                      # next cache write position
+        self.next_token = self.pending.pop(0)
+        self.emitted = []
+
+
+class DecodeLoop(object):
+    """Slot-scheduled continuous decoding over a transformer-LM parameter
+    set (``models/transformer.py`` naming: ``tok_embed_weight``,
+    ``layer{i}_...``, ``final_ln_*``, ``lm_head_*``).
+
+    ``generate(prompt, max_new_tokens)`` returns a :class:`GenerateFuture`;
+    sequences join a free slot as soon as one retires — the decode body
+    never stops for a new arrival.
+    """
+
+    def __init__(self, params, num_layers, num_heads, max_len, slots=4,
+                 eos_id=None, health=None, name=None):
+        import jax
+        import jax.numpy as jnp
+        from .. import tracecheck as _tc
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.max_len = int(max_len)
+        self.slots = int(slots)
+        self.eos_id = eos_id
+        self.health = health or ServingHealth(parent=SERVING_HEALTH)
+
+        self._params = {}
+        for k, v in params.items():
+            data = getattr(v, "data", v)
+            self._params[k] = jnp.asarray(np.asarray(data, np.float32))
+        for need in ("tok_embed_weight", "pos_embed_weight",
+                     "final_ln_gamma", "lm_head_weight", "lm_head_bias"):
+            if need not in self._params:
+                raise MXNetError(
+                    "DecodeLoop: params missing %r — expected the "
+                    "models/transformer.py parameter naming" % need)
+        vocab, embed = self._params["tok_embed_weight"].shape
+        if embed % self.num_heads:
+            raise MXNetError("DecodeLoop: embed %d %% num_heads %d != 0"
+                             % (embed, self.num_heads))
+        # jit-mode gather CLAMPS out-of-range indices: a position past the
+        # embedding table would silently reuse its last row (wrong tokens,
+        # zero errors) — fail loudly at construction instead
+        pos_rows = int(self._params["pos_embed_weight"].shape[0])
+        if self.max_len > pos_rows:
+            raise MXNetError(
+                "DecodeLoop: max_len %d exceeds the positional embedding "
+                "table (%d rows) — positions past it would be silently "
+                "clamped" % (self.max_len, pos_rows))
+        self.vocab_size = int(vocab)
+        head_dim = embed // self.num_heads
+        cache_shape = (self.num_layers, self.slots, self.num_heads,
+                       self.max_len, head_dim)
+        self._cache = {"k": jnp.zeros(cache_shape, np.float32),
+                       "v": jnp.zeros(cache_shape, np.float32)}
+
+        self.name = _tc.unique_name(name or "serving-decode")
+        jfn = jax.jit(_build_decode_fn(self.num_layers, self.num_heads),
+                      donate_argnums=(0,))
+        structs = self._structs(jax)
+        # AOT: the decode body compiles at LOAD time and registers with the
+        # static analyzer — the decode program rides the same gate as the
+        # bucket programs (donation of the cache included)
+        self._compiled = jfn.lower(*structs).compile()
+        self._jfn = jfn   # keep alive: the registry holds only a weakref
+        _tc.register_program(
+            "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
+                                          self.max_len),
+            jfn, structs, donate_argnums=(0,))
+
+        self._join_q = queue.Queue()
+        self._slots = [None] * self.slots
+        self._closed = False
+        self.dead = None
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="mxtpu-serve-decode",
+                                        daemon=True)
+        self._thread.start()
+
+    def _structs(self, jax):
+        def sds(x):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        cache_s = {k: sds(v) for k, v in self._cache.items()}
+        params_s = {k: sds(v) for k, v in self._params.items()}
+        tok_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
+        pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
+        return cache_s, params_s, tok_s, pos_s
+
+    # ------------------------------------------------------------------
+    def generate(self, prompt, max_new_tokens):
+        """Queue one sequence; returns a :class:`GenerateFuture` whose
+        ``result()`` is the list of generated token ids."""
+        if self.dead is not None or self._closed:
+            raise ServingClosedError(
+                "decode loop is not running (%s)"
+                % (self.dead or "closed"))
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise MXNetError("generate: empty prompt")
+        bad = [t for t in prompt if t < 0 or t >= self.vocab_size]
+        if bad:
+            # same clamp hazard as positions: an out-of-vocab id would
+            # silently embed as the last vocab row
+            raise MXNetError(
+                "generate: prompt token id(s) %s outside the vocabulary "
+                "[0, %d)" % (bad[:5], self.vocab_size))
+        if len(prompt) + int(max_new_tokens) > self.max_len:
+            raise MXNetError(
+                "generate: prompt (%d) + max_new_tokens (%d) exceeds the "
+                "cache length %d" % (len(prompt), max_new_tokens,
+                                     self.max_len))
+        fut = GenerateFuture(self, prompt, max_new_tokens)
+        self._join_q.put(fut)
+        self._wake.set()
+        self.health.record_request()
+        return fut
+
+    def close(self):
+        self._closed = True
+        self._wake.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        self._shed(ServingClosedError("decode loop closed"))
+
+    # ------------------------------------------------------------------
+    def _shed(self, exc):
+        shed = 0
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                slot.fut.error = exc
+                slot.fut.event.set()
+                self._slots[i] = None
+                shed += 1
+        while True:
+            try:
+                fut = self._join_q.get_nowait()
+                fut.error = exc
+                fut.event.set()
+                shed += 1
+            except queue.Empty:
+                break
+        if shed:
+            self.health.record_shed(shed, exc)
+
+    def _admit(self):
+        for i in range(self.slots):
+            if self._slots[i] is not None:
+                continue
+            try:
+                fut = self._join_q.get_nowait()
+            except queue.Empty:
+                return
+            self._slots[i] = _Slot(fut)
+            self.health.record_join()
+
+    def _run(self):
+        from .. import faults as _faults
+        try:
+            while not self._closed:
+                self._admit()
+                if all(s is None for s in self._slots):
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                act = _faults.fire("serve.decode_die")
+                if act == "die":
+                    raise MXNetError(
+                        "injected decode-loop death (serve.decode_die)")
+                self._step()
+        except BaseException as e:   # shed, then die visibly
+            self.dead = e
+            self._shed(ServingClosedError(
+                "decode loop died: %r — request shed" % (e,)))
+            return
+
+    def _step(self):
+        import jax.numpy as jnp
+        tokens = np.zeros(self.slots, np.int32)
+        pos = np.zeros(self.slots, np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                tokens[i] = slot.next_token
+                pos[i] = slot.pos
+        new_cache, logits = self._compiled(
+            self._cache, self._params, jnp.asarray(tokens),
+            jnp.asarray(pos))
+        self._cache = new_cache
+        host_logits = np.asarray(logits)   # the one per-step readback
+        self.health.record_decode_step()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            slot.pos += 1
+            if slot.pending:
+                # prompt still feeding: next input is teacher-forced
+                slot.next_token = slot.pending.pop(0)
+            else:
+                tok = int(np.argmax(host_logits[i]))
+                slot.emitted.append(tok)
+                slot.next_token = tok
+                if (len(slot.emitted) >= slot.fut.max_new
+                        or (self.eos_id is not None and tok == self.eos_id)):
+                    self._retire(i)
+                    continue
+            if slot.pos >= self.max_len:
+                self._retire(i)
+
+    def _retire(self, i):
+        slot = self._slots[i]
+        self._slots[i] = None
+        slot.fut.tokens = list(slot.emitted)
+        slot.fut.event.set()
+        self.health.record_retire()
+
+    # ------------------------------------------------------------------
+    def check(self, const_bytes=None):
+        """Static-analyze the registered decode program; returns findings
+        (the CI serving gate asserts none — docs/serving.md)."""
+        from .. import tracecheck as _tc
+        return _tc.check_registered(const_bytes=const_bytes,
+                                    match=self.name + "/")

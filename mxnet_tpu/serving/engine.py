@@ -1,0 +1,299 @@
+"""AOT serving engine: shape-bucketed, ahead-of-time-compiled inference.
+
+The reference ships inference as a standalone minimal surface
+(``c_predict_api`` / amalgamation's ``MXNET_PREDICT_ONLY`` build — PAPER.md)
+because serving has different needs than training. This module is that
+surface rebuilt for the XLA substrate (docs/serving.md):
+
+* the stripped-head forward is ``jax.jit(...).lower(...).compile()``-d at
+  LOAD time for a fixed set of batch-size buckets, so the first request
+  never pays a trace/compile;
+* compiled executables can be serialized to disk and re-imported
+  (``export_compiled`` / ``executables=``), so a re-deploy is
+  cold-start-free;
+* every bucket program registers with :mod:`mxnet_tpu.tracecheck`, so the
+  serving program set rides the same host-sync / const-capture / dtype gate
+  as the training programs (``ci/serve.sh``).
+
+``infer`` pads a request batch up to the smallest covering bucket and
+slices the pad rows back off. Inference is per-example independent (eval
+BatchNorm uses moving stats, softmax is per-row), so padding can never leak
+into real rows — asserted bitwise in tests/test_serving.py.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+
+import numpy as np
+
+from ..base import MXNetError, env_str
+from ..executor import _build_graph_runner
+from ..predictor import (_strip_loss_heads, load_symbol, load_param_dict,
+                         pick_partial_outputs, check_missing_params)
+from .health import ServingHealth, SERVING_HEALTH
+
+#: default batch-size buckets (env: MXTPU_SERVE_BUCKETS="1,8,32")
+_DEFAULT_BUCKETS = (1, 8, 32)
+
+
+def default_buckets():
+    spec = env_str("MXTPU_SERVE_BUCKETS", "")
+    if not spec:
+        return _DEFAULT_BUCKETS
+    try:
+        buckets = tuple(sorted({int(s) for s in spec.split(",") if s.strip()}))
+    except ValueError:
+        raise MXNetError("MXTPU_SERVE_BUCKETS must be a comma-separated "
+                         "list of batch sizes, got %r" % spec)
+    if not buckets or buckets[0] < 1:
+        raise MXNetError("MXTPU_SERVE_BUCKETS needs positive batch sizes, "
+                         "got %r" % spec)
+    return buckets
+
+
+class ServingEngine(object):
+    """AOT-compiled, shape-bucketed forward over a saved checkpoint.
+
+    ``input_shapes`` maps input name -> PER-EXAMPLE shape (no batch dim),
+    e.g. ``{"data": (3, 224, 224)}``; ``buckets`` is the set of batch sizes
+    compiled ahead of time (default :func:`default_buckets`). ``infer``
+    accepts any request size: n <= max(buckets) dispatches one padded
+    bucket, larger requests are chunked over the largest bucket.
+
+    ``executables=`` points at a file previously written by
+    :meth:`export_compiled`; when it loads cleanly the engine starts with
+    ZERO compiles (cold-start-free deploy). A stale/mismatched file logs a
+    warning and falls back to fresh AOT compilation.
+    """
+
+    def __init__(self, symbol_json_or_file, param_file_or_dict, input_shapes,
+                 buckets=None, output_names=None, allow_missing=False,
+                 input_dtypes=None, executables=None, health=None,
+                 name=None):
+        import jax
+        from .. import tracecheck as _tc
+        self._symbol = _strip_loss_heads(load_symbol(symbol_json_or_file))
+        if output_names:
+            self._symbol = pick_partial_outputs(self._symbol, output_names)
+        arg_params, aux_params = load_param_dict(param_file_or_dict)
+        if not allow_missing:
+            check_missing_params(self._symbol, set(input_shapes),
+                                 arg_params, aux_params, who="ServingEngine")
+        self._input_names = list(input_shapes)
+        self._input_shapes = {n: tuple(int(d) for d in s)
+                              for n, s in input_shapes.items()}
+        self._input_dtypes = {
+            n: np.dtype((input_dtypes or {}).get(n, np.float32))
+            for n in self._input_names}
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (buckets or default_buckets()))))
+        if not self.buckets or self.buckets[0] < 1:
+            raise MXNetError("ServingEngine: buckets must be positive "
+                             "batch sizes, got %r" % (self.buckets,))
+        self.health = health or ServingHealth(parent=SERVING_HEALTH)
+        self.name = _tc.unique_name(name or "serving(%s)"
+                                    % (self._symbol.name,))
+
+        # resolve parameter/aux arrays against shapes inferred at the
+        # smallest bucket (param shapes are batch-independent)
+        shapes_b0 = self._full_shapes(self.buckets[0])
+        arg_shapes, out_shapes, aux_shapes = \
+            self._symbol.infer_shape(**shapes_b0)
+        shape_of = dict(zip(self._symbol.list_arguments(), arg_shapes))
+        aux_shape_of = dict(zip(self._symbol.list_auxiliary_states(),
+                                aux_shapes))
+        import jax.numpy as jnp
+
+        def as_dev(v, shape):
+            data = getattr(v, "data", v)  # NDArray or raw array
+            arr = jnp.asarray(np.asarray(data))
+            if tuple(arr.shape) != tuple(shape):
+                raise MXNetError(
+                    "ServingEngine: parameter shape %s does not match the "
+                    "graph's %s" % (tuple(arr.shape), tuple(shape)))
+            return arr
+
+        self._params = {}
+        for n in self._symbol.list_arguments():
+            if n in self._input_names:
+                continue
+            if n in arg_params:
+                self._params[n] = as_dev(arg_params[n], shape_of[n])
+            else:  # allow_missing=True: deliberate zero-fill
+                self._params[n] = jnp.zeros(shape_of[n], np.float32)
+        self._aux = {}
+        for n in self._symbol.list_auxiliary_states():
+            if n in aux_params:
+                self._aux[n] = as_dev(aux_params[n], aux_shape_of[n])
+            else:
+                self._aux[n] = jnp.zeros(aux_shape_of[n], np.float32)
+
+        run, nodes = _build_graph_runner(self._symbol)
+        needs_rng = any((not n.is_variable) and n.op.needs_rng
+                        for n in nodes)
+        # eval-mode forward never consumes randomness, but ops declared
+        # needs_rng still take a key argument; a tiny static key const is
+        # baked in (well under the const-capture lint threshold)
+        key = jax.random.key(0) if needs_rng else None
+
+        def _fwd(params, aux, batch):
+            arg_vals = dict(batch)
+            arg_vals.update(params)
+            outs, _aux_up = run(arg_vals, aux, key, False)
+            return tuple(outs)
+
+        self._jfn = jax.jit(_fwd)
+        self._compiled = {}
+        loaded = False
+        if executables is not None:
+            loaded = self._try_import(executables)
+        if not loaded:
+            for b in self.buckets:
+                self._compiled[b] = self._jfn.lower(
+                    *self._bucket_structs(b)).compile()
+        # register the whole bucket set with the static analyzer: the
+        # serving programs are gated exactly like the train-step programs
+        for b in self.buckets:
+            _tc.register_program("%s/bucket[b=%d]" % (self.name, b),
+                                 self._jfn, self._bucket_structs(b))
+        # per-output row factor: outputs whose leading dim is a multiple of
+        # the batch (e.g. the LM's (batch*seq, vocab) head) slice by it
+        self._out_row_factor = []
+        for s in out_shapes:
+            lead = int(s[0]) if s else 0
+            self._out_row_factor.append(
+                lead // self.buckets[0]
+                if lead and lead % self.buckets[0] == 0 else None)
+
+    # ------------------------------------------------------------------
+    def _full_shapes(self, b):
+        return {n: (b,) + self._input_shapes[n] for n in self._input_names}
+
+    def _bucket_structs(self, b):
+        import jax
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+
+        params_s = {n: sds(v) for n, v in self._params.items()}
+        aux_s = {n: sds(v) for n, v in self._aux.items()}
+        batch_s = {n: jax.ShapeDtypeStruct((b,) + self._input_shapes[n],
+                                           self._input_dtypes[n])
+                   for n in self._input_names}
+        return params_s, aux_s, batch_s
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        """Smallest compiled bucket covering ``n`` examples."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise MXNetError("ServingEngine: no bucket covers %d examples "
+                         "(buckets %s); chunk the request or add a bucket"
+                         % (n, list(self.buckets)))
+
+    # ------------------------------------------------------------------
+    def infer(self, inputs):
+        """Run the compiled forward over ``{name: (n, ...) array}``; returns
+        a list of np arrays with pad rows already sliced off. Requests
+        larger than the biggest bucket are chunked."""
+        import jax.numpy as jnp
+        n = None
+        host = {}
+        for name in self._input_names:
+            if name not in inputs:
+                raise MXNetError("infer: missing input %r (need %s)"
+                                 % (name, self._input_names))
+            v = np.asarray(inputs[name], self._input_dtypes[name])
+            if tuple(v.shape[1:]) != self._input_shapes[name]:
+                raise MXNetError(
+                    "infer: input %r per-example shape %s != %s"
+                    % (name, tuple(v.shape[1:]), self._input_shapes[name]))
+            if n is None:
+                n = v.shape[0]
+            elif v.shape[0] != n:
+                raise MXNetError("infer: inputs disagree on batch size "
+                                 "(%d vs %d)" % (n, v.shape[0]))
+            host[name] = v
+        if n == 0:
+            raise MXNetError("infer: empty request")
+        if n > self.max_batch:
+            chunks = [self.infer({k: v[i:i + self.max_batch]
+                                  for k, v in host.items()})
+                      for i in range(0, n, self.max_batch)]
+            return [np.concatenate([c[i] for c in chunks])
+                    for i in range(len(chunks[0]))]
+        b = self.bucket_for(n)
+        if b > n:
+            host = {k: np.concatenate(
+                [v, np.zeros((b - n,) + v.shape[1:], v.dtype)])
+                for k, v in host.items()}
+        batch = {k: jnp.asarray(v) for k, v in host.items()}
+        outs = self._compiled[b](self._params, self._aux, batch)
+        self.health.record_batch(n, b - n)
+        res = []
+        for o, f in zip(outs, self._out_row_factor):
+            a = np.asarray(o)
+            res.append(a[:n * f] if f else a)
+        return res
+
+    # ------------------------------------------------------------------
+    # serialized executables: cold-start-free deploys
+    # ------------------------------------------------------------------
+    def _meta(self):
+        return {"buckets": list(self.buckets),
+                "input_shapes": {n: list(s)
+                                 for n, s in self._input_shapes.items()},
+                "input_dtypes": {n: str(d)
+                                 for n, d in self._input_dtypes.items()}}
+
+    def export_compiled(self, path):
+        """Serialize every bucket's compiled executable to ``path``
+        (atomic write). A later ``ServingEngine(..., executables=path)``
+        on the same backend skips compilation entirely. Raises
+        :class:`MXNetError` when the backend cannot serialize."""
+        from jax.experimental import serialize_executable as _se
+        from ..model import atomic_write_bytes
+        payload = {"version": 1, "meta": self._meta(), "buckets": {}}
+        try:
+            for b, comp in self._compiled.items():
+                payload["buckets"][b] = _se.serialize(comp)
+        except Exception as e:
+            raise MXNetError(
+                "export_compiled: this backend cannot serialize compiled "
+                "executables (%r)" % (e,)) from e
+        atomic_write_bytes(path, pickle.dumps(payload))
+        return path
+
+    def _try_import(self, path):
+        from jax.experimental import serialize_executable as _se
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.loads(f.read())
+            if payload.get("meta") != self._meta():
+                raise MXNetError(
+                    "executable file %s was exported for a different "
+                    "bucket/shape configuration" % (path,))
+            for b in self.buckets:
+                blob, in_tree, out_tree = payload["buckets"][b]
+                self._compiled[b] = _se.deserialize_and_load(
+                    blob, in_tree, out_tree)
+            return True
+        except Exception as e:
+            logging.warning(
+                "ServingEngine: could not import executables from %s (%s) "
+                "— falling back to fresh AOT compilation", path, e)
+            self._compiled = {}
+            return False
+
+    # ------------------------------------------------------------------
+    def check(self, const_bytes=None):
+        """Static-analyze this engine's registered bucket programs
+        (docs/static_analysis.md); returns the findings."""
+        from .. import tracecheck as _tc
+        return _tc.check_registered(const_bytes=const_bytes,
+                                    match=self.name + "/")

@@ -426,6 +426,29 @@ def retrace_count():
     return len(RETRACE_EVENTS)
 
 
+#: per-base-name sequence numbers for :func:`unique_name` — registry names
+#: must stay process-unique even when symbols share a name (the default
+#: "softmax" head is common), or a second instance's programs would shadow
+#: the first's in ``PROGRAMS`` and audits would silently check the wrong set
+_NAME_SEQ = {}
+
+
+def unique_name(base):
+    """Process-unique program/watcher base name: first caller gets ``base``
+    verbatim, later callers get ``base#2``, ``base#3``, ... Shared by
+    ``TrainStep`` and the serving tier so their registry entries never
+    collide."""
+    n = _NAME_SEQ.get(base, 0) + 1
+    _NAME_SEQ[base] = n
+    return base if n == 1 else "%s#%d" % (base, n)
+
+
+def make_watcher(base):
+    """A :class:`TraceWatcher` under a process-unique name (see
+    :func:`unique_name`)."""
+    return TraceWatcher(unique_name(base))
+
+
 class TraceWatcher(object):
     """Per-call-site retrace detector: records the argument signature and
     the jit entry's ``_cache_size()`` after every watched call; when the

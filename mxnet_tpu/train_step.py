@@ -28,10 +28,6 @@ from . import optimizer as _opt
 from .optimizer import Optimizer
 from . import random as _random
 
-#: monotonic id for tracecheck watcher names — registry names must stay
-#: unique across TrainStep instances even when symbols share a name
-_TC_WATCHER_SEQ = 0
-
 P = jax.sharding.PartitionSpec
 
 # rng stream offset so optimizer noise keys (SGLD) never collide with the
@@ -673,17 +669,13 @@ class TrainStep(object):
         if not _tc.enabled():
             return
         if self._watcher is None:
-            # names are process-unique: two TrainSteps over same-named
-            # symbols (the default "softmax" head is common) must not
-            # collide in the program registry, or the second instance's
-            # programs would never register and check_registered would
-            # silently audit the wrong instance's program set
-            global _TC_WATCHER_SEQ
-            _TC_WATCHER_SEQ += 1
-            base = "TrainStep(%s)" % (self.symbol.name,)
-            if _TC_WATCHER_SEQ > 1:
-                base += "#%d" % _TC_WATCHER_SEQ
-            self._watcher = _tc.TraceWatcher(base)
+            # names are process-unique (tracecheck.make_watcher): two
+            # TrainSteps over same-named symbols must not collide in the
+            # program registry, or the second instance's programs would
+            # never register and check_registered would silently audit the
+            # wrong instance's program set
+            self._watcher = _tc.make_watcher(
+                "TrainStep(%s)" % (self.symbol.name,))
         if isinstance(cache_key, tuple):
             key = "%s[bs=%d,k=%d]" % ((kind,) + tuple(cache_key))
         else:

@@ -1,0 +1,227 @@
+"""Predictor satellites (ISSUE 6): loss-head stripping coverage, strict
+missing-parameter checking, and the reshape executor cache.
+
+``_strip_loss_heads`` is the contract the whole serving tier binds
+through — every ``_LOSS_HEADS`` entry must round-trip to its
+inference-time transform, label arguments must vanish, and partial-output
+predictors must compose with the stripping.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.predictor import _LOSS_HEADS, _strip_loss_heads  # noqa: E402
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# _strip_loss_heads: every entry round-trips
+# ---------------------------------------------------------------------------
+
+def _head_symbol(op_name, **attrs):
+    data = mx.sym.Variable("data")
+    make = getattr(mx.sym, op_name)
+    return make(data=data, name="head", **attrs)
+
+
+_EXPECTED_TRANSFORM = {
+    "SoftmaxOutput": lambda x: _softmax(x.reshape(x.shape[0], -1)
+                                        ).reshape(x.shape),
+    "LogisticRegressionOutput": lambda x: 1.0 / (1.0 + np.exp(-x)),
+    "LinearRegressionOutput": lambda x: x,
+    "MAERegressionOutput": lambda x: x,
+    "SVMOutput": lambda x: x,
+    "MakeLoss": lambda x: x,
+    "IdentityAttachKLSparseReg": lambda x: x,
+}
+
+
+@pytest.mark.parametrize("op_name", sorted(_LOSS_HEADS))
+def test_strip_loss_head_roundtrips(op_name):
+    """Each loss head strips to its inference transform, the label
+    argument vanishes, and the stripped symbol binds with data only."""
+    sym = _head_symbol(op_name)
+    stripped = _strip_loss_heads(sym)
+    args = stripped.list_arguments()
+    assert args == ["data"], "label must vanish from arguments: %s" % args
+    # binding needs NO label arrays
+    pred = mx.Predictor(stripped, {}, {"data": (3, 4)})
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = pred.forward(data=x).get_output(0).asnumpy()
+    np.testing.assert_allclose(out, _EXPECTED_TRANSFORM[op_name](x),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_strip_softmax_multi_output_channel_mode():
+    """SoftmaxOutput(multi_output=True) — softmax over dim 1 of
+    (batch, c, d1, ...) — must strip to CHANNEL-mode SoftmaxActivation,
+    not instance mode."""
+    sym = _head_symbol("SoftmaxOutput", multi_output=True)
+    stripped = _strip_loss_heads(sym)
+    node = stripped._outputs[0][0]
+    assert node.op.name == "SoftmaxActivation"
+    assert node.attrs["mode"] == "channel"
+    pred = mx.Predictor(stripped, {}, {"data": (2, 3, 5)})
+    x = np.random.RandomState(1).randn(2, 3, 5).astype(np.float32)
+    out = pred.forward(data=x).get_output(0).asnumpy()
+    np.testing.assert_allclose(out, _softmax(x, axis=1), rtol=1e-5,
+                               atol=1e-6)
+    # channel sums are 1 per (batch, position)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones((2, 5)), atol=1e-5)
+
+
+def test_strip_loss_heads_json_roundtrip():
+    """Stripping applies identically to a symbol reloaded from JSON (the
+    deploy path: save_checkpoint -> -symbol.json -> Predictor)."""
+    sym = _head_symbol("SoftmaxOutput")
+    reloaded = mx.sym.load_json(sym.tojson())
+    stripped = _strip_loss_heads(reloaded)
+    assert stripped.list_arguments() == ["data"]
+    assert stripped._outputs[0][0].op.name == "SoftmaxActivation"
+
+
+def test_strip_preserves_non_loss_outputs():
+    data = mx.sym.Variable("data")
+    plain = mx.sym.Activation(data=data, act_type="relu", name="relu0")
+    loss = mx.sym.SoftmaxOutput(data=data, name="softmax")
+    group = mx.sym.Group([plain, loss])
+    stripped = _strip_loss_heads(group)
+    names = [n.op.name for n, _ in stripped._outputs]
+    assert names == ["Activation", "SoftmaxActivation"]
+
+
+def _two_layer_net():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _two_layer_params(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"arg:fc1_weight": mx.nd.array(rs.randn(5, 4).astype(np.float32)),
+            "arg:fc1_bias": mx.nd.array(np.zeros(5, np.float32)),
+            "arg:fc2_weight": mx.nd.array(rs.randn(3, 5).astype(np.float32)),
+            "arg:fc2_bias": mx.nd.array(np.zeros(3, np.float32))}
+
+
+def test_partial_outputs_compose_with_stripping():
+    """output_names= picks an internal head AFTER stripping: the partial
+    predictor binds label-free and computes the internal activation."""
+    params = _two_layer_params()
+    pred = mx.Predictor(_two_layer_net(), params, {"data": (2, 4)},
+                        output_names=["relu1"])
+    assert "softmax_label" not in pred._symbol.list_arguments()
+    x = np.random.RandomState(2).rand(2, 4).astype(np.float32)
+    out = pred.forward(data=x).get_output(0).asnumpy()
+    w = params["arg:fc1_weight"].asnumpy()
+    ref = np.maximum(x @ w.T, 0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# missing-parameter strictness (satellite bugfix 1)
+# ---------------------------------------------------------------------------
+
+def test_missing_param_raises_naming_keys():
+    params = _two_layer_params()
+    del params["arg:fc2_weight"]
+    with pytest.raises(MXNetError, match="fc2_weight"):
+        mx.Predictor(_two_layer_net(), params, {"data": (2, 4)})
+
+
+def test_missing_param_zero_fill_is_opt_in():
+    params = _two_layer_params()
+    del params["arg:fc2_weight"]
+    pred = mx.Predictor(_two_layer_net(), params, {"data": (2, 4)},
+                        allow_missing=True)
+    out = pred.forward(data=np.ones((2, 4), np.float32)) \
+        .get_output(0).asnumpy()
+    # zero fc2_weight + zero bias => uniform softmax
+    np.testing.assert_allclose(out, np.full((2, 3), 1.0 / 3), atol=1e-6)
+
+
+def test_unstripped_head_label_not_counted_missing():
+    """A loss head outside _LOSS_HEADS keeps its label in
+    list_arguments(); the strict check must not demand it from the
+    checkpoint (labels are inputs, not parameters)."""
+    from mxnet_tpu.predictor import check_missing_params
+    data = mx.sym.Variable("data")
+    lbl = mx.sym.Variable("myloss_label")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = net * lbl            # custom loss shape: label stays an argument
+    assert "myloss_label" in out.list_arguments()
+    # complete weights, label absent: must NOT raise
+    check_missing_params(out, {"data"},
+                         {"fc_weight": 0, "fc_bias": 0}, {})
+    # a genuinely missing weight still raises
+    with pytest.raises(MXNetError, match="fc_bias"):
+        check_missing_params(out, {"data"}, {"fc_weight": 0}, {})
+
+
+def test_typoed_key_raises_not_garbage(tmp_path):
+    """The original bug: a typo'd checkpoint key was silently zero-filled
+    and the predictor served garbage. It must raise, naming the key."""
+    params = _two_layer_params()
+    params["arg:fc2_weihgt"] = params.pop("arg:fc2_weight")  # typo
+    with pytest.raises(MXNetError, match="fc2_weight"):
+        mx.Predictor(_two_layer_net(), params, {"data": (2, 4)})
+
+
+# ---------------------------------------------------------------------------
+# reshape executor cache (satellite bugfix 2)
+# ---------------------------------------------------------------------------
+
+def test_reshape_caches_executors_per_shape():
+    """Alternating batch sizes must reuse the executor bound for each
+    shape (one bind/compile per shape, ever) — the serving batcher's
+    bucket flipping depends on this."""
+    pred = mx.Predictor(_two_layer_net(), _two_layer_params(),
+                        {"data": (2, 4)})
+    e2 = pred._executor
+    pred.reshape({"data": (6, 4)})
+    e6 = pred._executor
+    assert e6 is not e2
+    pred.reshape({"data": (2, 4)})
+    assert pred._executor is e2       # cache hit, no rebind
+    pred.reshape({"data": (6, 4)})
+    assert pred._executor is e6
+    # numerics survive the flips
+    x = np.random.RandomState(3).rand(6, 4).astype(np.float32)
+    out6 = pred.forward(data=x).get_output(0).asnumpy()
+    pred.reshape({"data": (2, 4)})
+    out2 = pred.forward(data=x[:2]).get_output(0).asnumpy()
+    np.testing.assert_allclose(out6[:2], out2, rtol=1e-5, atol=1e-6)
+
+
+def test_reshape_exec_cache_is_bounded():
+    """The executor cache is LRU-bounded: a server fed unquantized batch
+    sizes must not pin one compiled program per distinct size forever."""
+    pred = mx.Predictor(_two_layer_net(), _two_layer_params(),
+                        {"data": (2, 4)})
+    cap = mx.Predictor._EXEC_CACHE_CAP
+    for n in range(1, cap + 5):
+        pred.reshape({"data": (n, 4)})
+    assert len(pred._exec_cache) <= cap
+    # the current executor survives eviction churn and still computes
+    x = np.random.RandomState(4).rand(cap + 4, 4).astype(np.float32)
+    out = pred.forward(data=x).get_output(0).asnumpy()
+    assert out.shape == (cap + 4, 3)
+
+
+def test_reshape_still_rejects_parameter_shape_changes():
+    pred = mx.Predictor(_two_layer_net(), _two_layer_params(),
+                        {"data": (2, 4)})
+    with pytest.raises(MXNetError, match="parameter"):
+        pred.reshape({"data": (2, 9)})
