@@ -25,6 +25,15 @@ BENCH_STEPS_PER_DISPATCH (default 1; >=2 enables the steady-state bulked
 mode: K steps per lax.scan dispatch over a device-resident superbatch with
 metrics read back once per K — docs/perf.md "Dispatch bulking").
 
+BENCH_DP_DEVICES=N adds a data-parallel scaling row to the JSON line
+(docs/perf.md "Data-parallel scaling"): the same train-step config is
+measured twice through the fused K-step scan — single device, and sharded
+over an N-way 'data' mesh at the SAME global batch (params replicated,
+batch axis split, gradient psum inside the donated body) — and the line
+gains ``dp: {n_devices, img_per_sec, img_per_sec_1chip,
+scaling_efficiency}``. Needs N visible devices (on CPU:
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+
 BENCH_SERVE=1 switches to the serving latency bench (docs/serving.md):
 drive the dynamic batcher over the AOT shape-bucketed engine at a target
 QPS with open-loop arrivals and report request latency p50/p99 plus
@@ -283,6 +292,83 @@ def serve_main():
     print(json.dumps(out))
 
 
+def measure_scan_ips(step, state, sb, batch, k, n_short, n_long, rounds=2,
+                     warmup=2):
+    """Steady-state img/s of the fused K-step scan: short/long differencing
+    (fixed per-readback latency cancels — same methodology as the headline
+    bench), best of ``rounds`` so one scheduler hiccup costs a retry, not
+    the measurement (a round whose timing inverts contributes nothing).
+    Shared by BENCH_DP_DEVICES and the multichip CI gate — ONE harness, so
+    the efficiency ratio always compares like with like."""
+    st = [state]
+
+    def run(dispatches):
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            st[0], _m = step.run_steps(st[0], sb)
+        np.asarray(st[0]["step"])  # forced readback (tunnel-honored sync)
+        return time.perf_counter() - t0
+
+    run(warmup)  # warmup / compile
+    best = 0.0
+    for _ in range(rounds):
+        t_short = run(n_short)
+        t_long = run(n_long)
+        if t_long > t_short:
+            best = max(best, batch * k * (n_long - n_short)
+                       / (t_long - t_short))
+    if best == 0.0:
+        # every round's timing inverted: the 0.0 a caller is about to
+        # publish (or gate on) is a measurement failure, not a throughput
+        print("WARNING: measure_scan_ips produced no valid sample — "
+              "t_long <= t_short in all %d round(s); the host is too "
+              "loaded for n_short=%d/n_long=%d dispatches"
+              % (rounds, n_short, n_long), file=sys.stderr)
+    return best
+
+
+def _dp_scaling_row(sym, dshape, batch, sdtype, cdtype, remat, spd, rounds):
+    """BENCH_DP_DEVICES=N: measure the fused K-step scan single-device and
+    sharded over an N-way 'data' mesh at the SAME global batch (docs/perf.md
+    "Data-parallel scaling"). Both sides run the identical run_steps harness
+    so the efficiency ratio compares like with like; the superbatch is
+    device-resident (landed sharded once), so this is pure step scaling,
+    not input scaling."""
+    from mxnet_tpu.train_step import TrainStep
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+
+    n = int(os.environ.get("BENCH_DP_DEVICES"))
+    k = max(1, spd)
+
+    def measure(mesh):
+        step = TrainStep(
+            sym, optimizer="sgd", learning_rate=0.1, momentum=0.9, wd=1e-4,
+            dtype=sdtype, mesh=mesh,
+            remat={"conv": "conv", "full": True}.get(remat, False),
+            compute_dtype=None if cdtype == "float32" else cdtype)
+        state = step.init({"data": dshape}, {"softmax_label": (batch,)})
+        rng = np.random.default_rng(0)
+        sb = step.shard_superbatch({
+            "data": np.stack([rng.normal(size=dshape).astype(np.float32)]
+                             * k),
+            "softmax_label": np.stack(
+                [rng.integers(0, 1000, batch).astype(np.float32)] * k)})
+        # keep measured *steps* roughly constant as K grows (as main does)
+        n_short = max(2, (20 + k - 1) // k)
+        n_long = max(n_short + 5, (120 + k - 1) // k)
+        return measure_scan_ips(step, state, sb, batch, k, n_short, n_long,
+                                rounds=rounds)
+
+    ips1 = measure(None)
+    ipsn = measure(data_parallel_mesh(n))
+    return {
+        "n_devices": n,
+        "img_per_sec": round(ipsn, 2),
+        "img_per_sec_1chip": round(ips1, 2),
+        "scaling_efficiency": (round(ipsn / ips1, 3) if ips1 > 0 else None),
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -294,6 +380,21 @@ def main():
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    dp_n = int(os.environ.get("BENCH_DP_DEVICES", "0") or 0)
+    if dp_n > 1:
+        # validate BEFORE the headline measurement: a misconfigured env
+        # must not discard minutes of already-measured throughput
+        if len(jax.devices()) < dp_n:
+            raise SystemExit(
+                "BENCH_DP_DEVICES=%d but only %d device(s) are visible — "
+                "on CPU raise the count with XLA_FLAGS="
+                "--xla_force_host_platform_device_count=%d"
+                % (dp_n, len(jax.devices()), dp_n))
+        if batch % dp_n:
+            raise SystemExit(
+                "BENCH_DP_DEVICES=%d does not divide BENCH_BATCH=%d — the "
+                "sharded scan needs equal per-chip shards"
+                % (dp_n, batch))
     baseline = 181.53  # P100, ResNet-50 train b32 (docs/how_to/perf.md:183-190)
 
     # measured r4: remat=conv loses ~17% on v5e (recompute re-reads conv
@@ -431,6 +532,9 @@ def main():
             out["mfu"] = round(ips * flops_per_img / peak, 4)
             out["device_kind"] = kind
             out["peak_tflops_bf16"] = peak / 1e12
+    if dp_n > 1:
+        out["dp"] = _dp_scaling_row(sym, dshape, batch, sdtype, cdtype,
+                                    remat, spd, rounds)
     print(json.dumps(out))
 
 

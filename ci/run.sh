@@ -20,6 +20,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
 # tracecheck findings on the serving program set (docs/serving.md)
 ./ci/serve.sh
+# multichip gate (docs/perf.md "Data-parallel scaling"): MEASURED — 8-device
+# fused-fit img/s + scaling efficiency vs 1 device (floor
+# MXTPU_MULTICHIP_MIN_EFF, default 0.7), guard + bitwise checkpoint/resume
+# composition, collective/donation audit of the sharded program set; emits
+# MULTICHIP_r*.json
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 # chip stage: hard convergence gates + the ImageNet recipe compile-check
 # (uses the real TPU when attached; tools default to the ambient platform).

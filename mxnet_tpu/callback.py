@@ -65,6 +65,25 @@ class Speedometer(object):
         self._retrace_base = None  # tracecheck retrace count at init-fire
 
     @staticmethod
+    def _speed_scale(param):
+        """GLOBAL-throughput factor for multi-process data parallelism:
+        each worker's iterator yields its LOCAL batch shard, so the
+        per-window speed must scale by the number of workers (per-chip
+        local batch x axis size = global batch). Read from the training
+        module via ``param.locals['self']`` (``Module._global_batch_scale``)
+        — single-process runs, score() streams and foreign callback params
+        all scale by 1."""
+        loc = getattr(param, "locals", None)
+        mod = loc.get("self") if isinstance(loc, dict) else None
+        scale = getattr(mod, "_global_batch_scale", None)
+        if not callable(scale):
+            return 1.0
+        try:
+            return float(scale())
+        except Exception:
+            return 1.0
+
+    @staticmethod
     def _health_suffix(param):
         """THIS run's TrainingHealth counters when it is guarded, empty
         otherwise — strictly per-run: the guard rides in through
@@ -131,6 +150,7 @@ class Speedometer(object):
             # speed by the true batch delta since the last fire
             if count // self.frequent > self._fired // self.frequent:
                 speed = ((count - self._fired) * self.batch_size
+                         * self._speed_scale(param)
                          / (time.time() - self.tic))
                 health = self._health_suffix(param) \
                     + self._pipeline_suffix(param) \

@@ -97,6 +97,22 @@ def set_dispatch_pipeline(depth):
     return prev
 
 
+def dp_devices():
+    """Default data-parallel device count for ``Module`` (docs/perf.md
+    "Data-parallel scaling"): ``MXTPU_DP_DEVICES=N`` makes a Module built
+    without an explicit ``context=`` spread over the first N local devices
+    — the env-knob spelling of ``context=[mx.cpu(i) for i in range(N)]``.
+    0/unset keeps the single-device default."""
+    v = os.environ.get("MXTPU_DP_DEVICES")
+    if v is None or v.strip() == "":
+        return 0
+    try:
+        return max(0, int(v))
+    except ValueError:
+        from .base import MXNetError
+        raise MXNetError("MXTPU_DP_DEVICES must be an integer, got %r" % v)
+
+
 _tracecheck_override = None
 
 

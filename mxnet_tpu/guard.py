@@ -27,6 +27,16 @@ Policy knobs default from ``MXTPU_GUARD_*`` env vars (docs/robustness.md
 "Numerical guardrails"); fault sites ``guard.grad_nan``,
 ``guard.loss_spike`` and ``guard.param_nan`` make every path
 deterministically testable (:mod:`mxnet_tpu.faults`).
+
+Under a data-parallel mesh (docs/perf.md "Data-parallel scaling") the
+sentinels are GLOBAL by construction: the all-finite flag and the gradient
+norm are computed over the post-all-reduce gradients, so one chip's NaN
+shard poisons the global gradient, every chip sees the same flag, and the
+no-op select is taken identically everywhere — there is no per-chip
+divergence for the policy to reconcile. The packed
+``[loss, correct, nsamp, skipped, gnorm]`` array rides back replicated in
+the same single readback, so ``on_dispatch`` consumes chip-count-N
+sentinels exactly as it consumes N=1 ones.
 """
 from __future__ import annotations
 

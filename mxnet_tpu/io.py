@@ -453,11 +453,20 @@ class SuperBatchIter(DataIter):
     The epoch tail (fewer than K batches left) is yielded as a partial
     superbatch with ``num_steps < k``, or dropped with
     ``last_group_handle='discard'``.
+
+    ``sharding`` (a ``jax.sharding.Sharding``, normally
+    ``parallel.mesh.superbatch_sharding(mesh)``) makes the producer land
+    every stacked array PER-CHIP SHARDED: the single H2D device_put splits
+    the batch axis across the mesh's 'data' axis, so each chip receives
+    only its own shard and the K-step dispatch consumes the superbatch
+    with zero resharding copies (docs/perf.md "Data-parallel scaling").
+    ``Module.fit`` wires this automatically when its fused path runs over
+    a mesh.
     """
 
     def __init__(self, base, k, prefetch=True, queue_depth=None,
                  last_group_handle="partial", retry_policy=None,
-                 data_health=None):
+                 data_health=None, sharding=None):
         super().__init__(getattr(base, "batch_size", 0))
         if queue_depth is None:
             # keep the producer ahead of fit's dispatch pipeline
@@ -474,6 +483,7 @@ class SuperBatchIter(DataIter):
                              "'partial' or 'discard'")
         self.base = base
         self.k = int(k)
+        self.sharding = sharding
         self.last_group_handle = last_group_handle
         self.retry_policy = retry_policy or RetryPolicy()
         self.data_health = (data_health if data_health is not None
@@ -536,9 +546,11 @@ class SuperBatchIter(DataIter):
     def _stack(self, parts):
         """One stacked array per slot; host parts take a single np.stack +
         device put (ONE H2D for the whole superbatch slot), device parts
-        stack on device. The device transfer (fault site ``io.h2d``) is
-        retried like any transient IO: a flaky transfer costs a retry, not
-        the run."""
+        stack on device. Under ``sharding`` the device_put itself splits
+        the batch axis, so the land IS the per-chip scatter — no follow-up
+        resharding. The device transfer (fault site ``io.h2d``) is retried
+        like any transient IO: a flaky transfer costs a retry, not the
+        run."""
         from . import faults as _faults
         raw = [p.data if isinstance(p, NDArray) else p for p in parts]
         if all(isinstance(r, np.ndarray) for r in raw):
@@ -546,12 +558,25 @@ class SuperBatchIter(DataIter):
 
             def land():
                 _faults.fire("io.h2d")
+                if self.sharding is not None:
+                    import jax
+                    # mirror array()'s dtype policy: a default-dtype f64
+                    # host batch must land f32 on the sharded path too, or
+                    # the mesh run retraces (and numerically diverges from)
+                    # the single-device program under jax_enable_x64
+                    src = (stacked.astype(np.float32)
+                           if stacked.dtype == np.float64 else stacked)
+                    return NDArray(jax.device_put(src, self.sharding))
                 return array(stacked)
 
             return retry_call(land, "io.h2d", self.retry_policy,
                               self.data_health)
         import jax.numpy as jnp
-        return NDArray(jnp.stack([jnp.asarray(r) for r in raw]))
+        out = jnp.stack([jnp.asarray(r) for r in raw])
+        if self.sharding is not None:
+            import jax
+            out = jax.device_put(out, self.sharding)
+        return NDArray(out)
 
     def _assemble(self, group):
         n_data = len(group[0].data)

@@ -183,6 +183,22 @@ def _sha256_file(path):
     return h.hexdigest()
 
 
+def _as_host(v):
+    """One array to host numpy, sharding-aware: a mesh-sharded jax.Array
+    that is not fully addressable (multi-host data parallelism) is reduced
+    to this process's replicated/local view first — ``np.asarray`` on such
+    an array raises, which would make checkpointing a sharded run
+    impossible exactly when it matters (docs/perf.md "Data-parallel
+    scaling")."""
+    data = v.data if hasattr(v, "data") and hasattr(v, "asnumpy") else v
+    if not getattr(data, "is_fully_addressable", True):
+        from .parallel.mesh import local_view
+        return np.asarray(local_view(data))
+    if hasattr(v, "asnumpy"):
+        return v.asnumpy()
+    return np.asarray(v)
+
+
 def _param_save_bytes(arg_params, aux_params):
     """Serialize params to the dmlc .params byte layout (what nd.save
     writes), as bytes for the atomic writer."""
@@ -190,8 +206,7 @@ def _param_save_bytes(arg_params, aux_params):
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     names = list(save_dict.keys())
-    arrs = [save_dict[k].asnumpy() if hasattr(save_dict[k], "asnumpy")
-            else np.asarray(save_dict[k]) for k in names]
+    arrs = [_as_host(save_dict[k]) for k in names]
     return dmlc_serial.dumps(arrs, names)
 
 
@@ -680,7 +695,7 @@ class CheckpointManager(object):
         costs one host pass over data the save already hashed."""
         for tree in (arg_params, aux_params):
             for v in (tree or {}).values():
-                a = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+                a = _as_host(v)
                 if (np.issubdtype(a.dtype, np.floating)
                         and not np.isfinite(a).all()):
                     return False

@@ -193,6 +193,17 @@ class BaseModule(object):
             dispatch_pipeline=None):
         """The training loop (ref: base_module.py:368-519).
 
+        Data-parallel scaling (docs/perf.md "Data-parallel scaling"): a
+        Module built over multiple contexts (or ``MXTPU_DP_DEVICES=N``)
+        trains the SAME fused K-step scan over a 'data' mesh — superbatches
+        land per-chip sharded straight off the producer thread, params and
+        optimizer state are replicated, the gradient all-reduce runs inside
+        the donated compiled body, and the packed metric/sentinel array
+        comes back globally reduced so the per-K readback stays one small
+        host transfer. The guard and checkpoint/resume stack below compose
+        unchanged: a chip-count-N run checkpoints and resumes exactly like
+        N=1.
+
         ``steps_per_dispatch=k`` (default: ``engine.bulk_size()``, normally
         1) bulks K train steps into ONE compiled dispatch over a stacked
         superbatch: Python dispatch overhead and the per-step host metric
@@ -388,9 +399,17 @@ class BaseModule(object):
         if k <= 1 or fused_dispatch is None:
             pl_depth = 0
         pipeline = _DispatchPipeline(pl_depth)
-        train_iter = (train_data.superbatch(k,
-                                            queue_depth=max(2, pl_depth + 1))
-                      if k > 1 else train_data)
+        if k > 1:
+            # data-parallel mesh: hand the superbatch producer the batch-axis
+            # sharding so every stacked array LANDS per-chip sharded — the
+            # one H2D is the scatter, and the dispatch loop never pays a
+            # resharding copy (docs/perf.md "Data-parallel scaling")
+            sb_sharding = getattr(self, "_superbatch_sharding", None)
+            train_iter = train_data.superbatch(
+                k, queue_depth=max(2, pl_depth + 1),
+                sharding=sb_sharding() if sb_sharding is not None else None)
+        else:
+            train_iter = train_data
 
         note_retired = getattr(self, "_note_dispatch_retired", None)
 

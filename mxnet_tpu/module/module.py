@@ -32,7 +32,25 @@ class Module(BaseModule):
         super().__init__(logger=logger)
         if context is None:
             from ..context import current_context
+            from .. import engine as _engine
             context = current_context()
+            n_dp = _engine.dp_devices()
+            if n_dp > 1:
+                # MXTPU_DP_DEVICES=N: spread over the first N local devices
+                # (docs/perf.md "Data-parallel scaling"). Distinctness is
+                # what makes the executor group build a 'data' mesh, so an
+                # over-ask fails actionably instead of silently collapsing
+                # onto one device
+                import jax
+                avail = len(jax.local_devices())
+                if n_dp > avail:
+                    raise MXNetError(
+                        "MXTPU_DP_DEVICES=%d but only %d local device(s) "
+                        "are visible — on CPU, raise the count with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count"
+                        "=%d" % (n_dp, avail, n_dp))
+                context = [Context(context.device_type, i)
+                           for i in range(n_dp)]
         if isinstance(context, Context):
             context = [context]
         self._context = context
@@ -538,7 +556,47 @@ class Module(BaseModule):
         if not self._infer_fused_metrics_ok():
             return (False, "device metric sums need a single (rank-2 "
                     "output, rank-1 label) head")
+        mesh = self._exec_group._mesh
+        if mesh is not None:
+            from ..parallel.mesh import data_axis_size
+            n = data_axis_size(mesh)
+            if self._exec_group.batch_size % n:
+                return (False, "global batch %d does not divide the %d-way "
+                        "'data' mesh axis — the sharded scan needs equal "
+                        "per-chip shards" % (self._exec_group.batch_size, n))
         return True, None
+
+    def _superbatch_sharding(self):
+        """The NamedSharding ``fit`` hands to :class:`~mxnet_tpu.io.\
+SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
+        replicated, batch axis split over 'data') — one sharded H2D on the
+        producer thread, zero resharding in the dispatch loop (docs/perf.md
+        "Data-parallel scaling"). None when the fused path runs without a
+        single-process mesh (single device, dist workers, per-step
+        configs)."""
+        mesh = self._exec_group._mesh if self._exec_group is not None \
+            else None
+        if mesh is None or self._is_dist_kvstore():
+            return None
+        from ..parallel.mesh import is_multiprocess, superbatch_sharding
+        if is_multiprocess(mesh):
+            return None
+        return superbatch_sharding(mesh)
+
+    def _global_batch_scale(self):
+        """Factor turning this process's per-iterator img/s into GLOBAL
+        img/s: >1 only in multi-process data parallelism, where each
+        worker's iterator yields its local shard of the global batch
+        (per-chip local batch x axis size = global batch). Speedometer
+        reads it through ``param.locals['self']``."""
+        if self._is_dist_kvstore():
+            return int(self._kvstore.num_workers)
+        if self._fused is not None:
+            from ..parallel.mesh import is_multiprocess
+            if is_multiprocess(self._fused.mesh):
+                import jax
+                return int(jax.process_count())
+        return 1
 
     def _can_guard(self):
         """fit()'s precheck for ``guard=``: the TrainingGuard's device

@@ -35,7 +35,8 @@ def pipeline_spmd(stage_fn, stacked_params, microbatches, axis_name="pipe"):
 
     Returns (M, ...) outputs of the LAST stage, identical on every device.
     """
-    S = jax.lax.axis_size(axis_name)
+    from .mesh import axis_size
+    S = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     M = microbatches.shape[0]
     local_params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
@@ -85,7 +86,8 @@ def pipeline_apply(stage_fn, stacked_params, batch, mesh, axis_name="pipe",
     micro = batch.reshape((M, B // M) + batch.shape[1:])
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    fn = jax.shard_map(
+    from .mesh import shard_map_compat
+    fn = shard_map_compat(
         functools.partial(pipeline_spmd, stage_fn, axis_name=axis_name),
         mesh=mesh,
         in_specs=(pspec, P()),

@@ -44,6 +44,16 @@ lint id                   fires when
                           float/complex dtype
 ``dtype-weak``            a weak-typed program input (a bare Python scalar
                           reached the trace)
+``collective-in-scan``    a gather-type collective (all-gather /
+                          all-to-all / reduce-scatter) sits inside a scan
+                          body — the expected data-parallel program syncs
+                          only by psum (the grad/metric all-reduce), so a
+                          gather there means a sharding mistake replaying
+                          K times per dispatch. Jaxpr pass catches explicit
+                          (shard_map) collectives;
+                          :func:`check_collectives` additionally compiles
+                          the partitioned program and audits the collectives
+                          GSPMD inserted
 ========================  ==================================================
 
 Suppression: put ``# tracecheck: ignore[lint-id]`` (or a bare
@@ -80,7 +90,26 @@ import numpy as np
 from .base import MXNetError
 
 LINTS = ("host-sync", "retrace", "donation", "const-capture", "dtype-f64",
-         "dtype-weak")
+         "dtype-weak", "collective-in-scan")
+
+#: gather-type collective primitives that must NOT appear inside a scan
+#: body (jaxpr level — explicit shard_map collectives). ``psum`` is the
+#: expected grad/metric sync and ``ppermute`` the ring/pipeline schedule
+#: (value-preserving, constant payload per step) — both allowed.
+_SCAN_COLLECTIVE_PRIMS = frozenset({
+    "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
+    "pgather",
+})
+
+#: compiled-HLO collective opcodes; ``all-reduce`` is the expected
+#: grad/metric psum, everything else inside a while body is a finding
+_HLO_COLLECTIVE_KINDS = ("all-gather", "all-to-all", "reduce-scatter",
+                         "collective-permute", "all-reduce")
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s+\S+\s+(%s)(?:-start)?\("
+    % "|".join(re.escape(kind) for kind in _HLO_COLLECTIVE_KINDS))
+_HLO_OPNAME_RE = re.compile(r'op_name="([^"]+)"')
+_HLO_SOURCE_RE = re.compile(r'source_file="([^"]+)"\s+source_line=(\d+)')
 
 #: callback-ish primitives whose presence inside a compiled step program
 #: means a host round-trip on every execution (the scan body runs them K
@@ -653,6 +682,83 @@ def _lint_consts(closed, const_bytes, name):
     return findings
 
 
+def _lint_collectives(closed, name):
+    """Jaxpr half of ``collective-in-scan``: explicit (shard_map-style)
+    gather-type collectives inside a scan/while body. GSPMD-inserted
+    collectives don't exist at jaxpr level — :func:`check_collectives`
+    compiles the partitioned program and audits those."""
+    findings = []
+    for eqn, path in walk_jaxpr(closed.jaxpr):
+        pname = eqn.primitive.name
+        if pname not in _SCAN_COLLECTIVE_PRIMS:
+            continue
+        parents = path.split("/")[:-1]
+        if "scan" not in parents and "while" not in parents:
+            continue
+        findings.append(Finding(
+            "collective-in-scan", name,
+            "gather-type collective %r inside the scan body (runs K times "
+            "per dispatch) — a data-parallel step syncs only by psum (the "
+            "grad/metric all-reduce); a gather here usually means a "
+            "sharding that forces the full batch onto every chip" % pname,
+            op_path=path, provenance=_provenance(eqn)))
+    return findings
+
+
+def check_collectives(fn, args=(), kwargs=None, name=None,
+                      allow=("all-reduce", "collective-permute")):
+    """Compiled-HLO half of ``collective-in-scan``: COMPILE the program
+    (partitioning happens at compile time, so GSPMD-inserted collectives
+    are invisible to the jaxpr/StableHLO passes) and flag every collective
+    opcode inside a while body that is not in ``allow``. The expected
+    data-parallel K-step scan lowers to all-reduces only — one combined
+    gradient sync plus the packed metric/sentinel reduction; any
+    all-gather / reduce-scatter / all-to-all in the loop body is a
+    sharding mistake paying its bandwidth K times per dispatch. The
+    default ``allow`` matches the jaxpr pass: all-reduce (psum, the
+    expected sync) and collective-permute (ppermute — the value-preserving
+    ring/pipeline schedule, constant payload per step).
+
+    ``fn`` may be a jitted function or a plain callable; ``args`` must
+    carry the REAL shardings (device arrays or ShapeDtypeStructs with
+    ``sharding=``) — unsharded arguments compile an unpartitioned program
+    with no collectives at all. Compiling is the cost of this check: use
+    it on gates and tests, not in per-dispatch paths. Returns findings
+    with suppressions applied, like :func:`check_program`."""
+    import jax
+    kwargs = dict(kwargs or {})
+    if name is None:
+        name = getattr(fn, "__name__", None) or repr(fn)
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    txt = jitted.lower(*args, **kwargs).compile().as_text()
+    findings = []
+    for line in txt.splitlines():
+        m = _HLO_COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        if kind in (allow or ()):
+            continue
+        op = _HLO_OPNAME_RE.search(line)
+        op_name = op.group(1) if op else ""
+        if "/while/" not in op_name:
+            # outside the loop: a once-per-dispatch collective (e.g. a
+            # final output gather) is not this lint's business
+            continue
+        src = _HLO_SOURCE_RE.search(line)
+        prov = ("%s:%s" % (src.group(1), src.group(2))) if src else None
+        findings.append(Finding(
+            "collective-in-scan", name,
+            "compiled program runs %r inside the scan body (op %s) — the "
+            "partitioned K-step dispatch should sync only by all-reduce "
+            "(grad + metric psum); this collective pays its bandwidth K "
+            "times per dispatch" % (kind, op_name or "?"),
+            op_path=op_name or "while/body", provenance=prov))
+    for f in findings:
+        f.suppressed = _is_suppressed(f)
+    return findings
+
+
 _MAIN_SIG_RE = re.compile(r"func\.func\s+public\s+@main\((?P<params>.*?)\)"
                           r"\s*->", re.S)
 _PARAM_SPLIT_RE = re.compile(r"%arg\d+:")
@@ -740,6 +846,7 @@ def check_program(fn, args=(), kwargs=None, donate_argnums=(), name=None,
     findings += _lint_host_sync(closed, hlo_text, name)
     findings += _lint_dtype(closed, args, kwargs, name)
     findings += _lint_consts(closed, const_bytes, name)
+    findings += _lint_collectives(closed, name)
     findings += _lint_donation(closed, hlo_text, wlog, donate_argnums,
                                args, kwargs, name)
     for f in findings:

@@ -136,7 +136,15 @@ def _metric_step_sums(outs, batch, label_names, zero):
         if (lbl is not None and getattr(o, "ndim", 0) == 2
                 and lbl.ndim == 1 and o.shape[0] == lbl.shape[0]):
             li = lbl.astype(jnp.int32)
-            p = o[jnp.arange(o.shape[0]), li].astype(jnp.float32)
+            # take_along_axis, NOT o[arange(bs), li]: the batch dim of both
+            # operand and indices stays aligned, so under a data-parallel
+            # mesh GSPMD keeps the gather fully per-shard. The arange
+            # fancy-index looks identical but loses that alignment and
+            # lowers to THREE all-gathers inside the scan body (the
+            # collective-in-scan lint pins this); on one device both forms
+            # gather the same elements and are bitwise identical
+            p = jnp.take_along_axis(o, li[:, None], axis=1)[:, 0] \
+                .astype(jnp.float32)
             # eps pinned f32: a bare Python 1e-8 is weak-typed and would
             # promote to f64 under jax_enable_x64 (tracecheck dtype lint);
             # on the default config the pin is bitwise-identical
@@ -382,9 +390,20 @@ class TrainStep(object):
         part_index/num_parts)."""
         if self.mesh is None:
             return batch
-        from .parallel.mesh import is_multiprocess, host_to_global, AXIS_SEQ
+        from .parallel.mesh import (is_multiprocess, host_to_global,
+                                    data_axis_size, AXIS_SEQ)
         has_seq = AXIS_SEQ in self.mesh.axis_names
         bax = "data" if "data" in self.mesh.axis_names else None
+        if bax is not None:
+            n = data_axis_size(self.mesh)
+            for k, v in batch.items():
+                b = (v.shape if hasattr(v, "shape")
+                     else np.asarray(v).shape)[0]
+                if b % n:
+                    raise MXNetError(
+                        "shard_batch: %r batch dim %d does not divide the "
+                        "%d-way 'data' mesh axis — pad the batch or pick a "
+                        "divisible batch size" % (k, b, n))
 
         def spec_for(v):
             nd = getattr(v, "ndim", None)
@@ -791,17 +810,31 @@ class TrainStep(object):
     def shard_superbatch(self, superbatch):
         """Place stacked (k, batch, ...) arrays for the scan dispatch: dim 0
         is the step axis (never sharded), dim 1 is the batch axis sharded
-        along 'data' — the superbatch analog of :meth:`shard_batch`."""
+        along 'data' — the superbatch analog of :meth:`shard_batch`.
+
+        Arrays already carrying the right NamedSharding (a
+        ``SuperBatchIter`` given ``sharding=`` lands them per-chip on the
+        producer thread) pass through ``jax.device_put`` as a no-op — the
+        dispatch hot loop then performs zero resharding copies."""
         def to_jnp(v):
             return v.data if isinstance(v, NDArray) else jnp.asarray(v)
         if self.mesh is None:
             return {n: to_jnp(v) for n, v in superbatch.items()}
-        from .parallel.mesh import is_multiprocess, AXIS_SEQ
+        from .parallel.mesh import (is_multiprocess, data_axis_size,
+                                    AXIS_SEQ)
         if is_multiprocess(self.mesh):
             raise MXNetError("shard_superbatch: multi-process meshes keep "
                              "per-step dispatch (use step())")
         has_seq = AXIS_SEQ in self.mesh.axis_names
         bax = "data" if "data" in self.mesh.axis_names else None
+        if bax is not None:
+            n = data_axis_size(self.mesh)
+            for name, v in superbatch.items():
+                b = getattr(v, "shape", (0, 0))[1]
+                if b % n:
+                    raise MXNetError(
+                        "shard_superbatch: %r batch dim %d does not divide "
+                        "the %d-way 'data' mesh axis" % (name, b, n))
 
         def spec_for(v):
             if has_seq and v.ndim >= 3:

@@ -52,7 +52,12 @@ def main(prefix, out_npz, k):
     w = rng.normal(size=(10, 4)).astype(np.float32)
     y = np.argmax(X @ w, axis=1).astype(np.float32)
     train = mx.io.NDArrayIter(X, y, batch_size=16)  # 16 batches/epoch
-    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    # RESUME_WORKER_CONTEXTS=N: train data-parallel over N devices (the
+    # 8-device bitwise kill-and-resume test — docs/perf.md "Data-parallel
+    # scaling"); the conftest-style XLA_FLAGS env is the parent's job
+    nctx = int(os.environ.get("RESUME_WORKER_CONTEXTS", "1") or 1)
+    ctx = [mx.cpu(i) for i in range(nctx)] if nctx > 1 else mx.cpu()
+    mod = mx.mod.Module(_mlp(), context=ctx)
 
     def cb(param):
         print("BATCH %d.%d" % (param.epoch, param.nbatch), flush=True)

@@ -555,3 +555,96 @@ def test_cli_json_output(capsys):
     data = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert isinstance(data, list)
+
+
+# ---------------------------------------------------------------------------
+# collective-in-scan (docs/perf.md "Data-parallel scaling")
+# ---------------------------------------------------------------------------
+
+def _dp_mesh(n=8):
+    import jax
+    import numpy as np
+    return jax.sharding.Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def test_collective_lint_flags_explicit_allgather_in_scan():
+    """Jaxpr half: an explicit shard_map all_gather inside a scan body is
+    a finding with the scan-rooted op path and the seeding line's
+    provenance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    P = jax.sharding.PartitionSpec
+    mesh = _dp_mesh()
+
+    def bad(xs):
+        def body(c, x):
+            g = jax.lax.all_gather(x, "data")
+            return c + jnp.sum(g), None
+        out, _ = jax.lax.scan(body, jnp.float32(0), xs)
+        return out
+
+    sm = shard_map(bad, mesh=mesh, in_specs=P(None, "data"), out_specs=P(),
+                   check_rep=False)
+    xs = jax.device_put(np.ones((4, 8), np.float32),
+                        jax.sharding.NamedSharding(mesh, P(None, "data")))
+    findings = [f for f in tc.check_program(jax.jit(sm), (xs,),
+                                            name="seeded-allgather")
+                if f.lint == "collective-in-scan"]
+    assert findings, "all_gather in scan body must be flagged"
+    assert "scan" in findings[0].op_path
+    assert findings[0].provenance and "test_tracecheck" in \
+        findings[0].provenance
+
+
+def test_collective_lint_allows_psum_in_scan():
+    """psum IS the expected grad/metric sync — a psum-only shard_map scan
+    stays clean on both the jaxpr pass and the compiled-HLO audit."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    P = jax.sharding.PartitionSpec
+    mesh = _dp_mesh()
+
+    def good(xs):
+        def body(c, x):
+            return c + jax.lax.psum(jnp.sum(x), "data"), None
+        out, _ = jax.lax.scan(body, jnp.float32(0), xs)
+        return out
+
+    sm = shard_map(good, mesh=mesh, in_specs=P(None, "data"), out_specs=P(),
+                   check_rep=False)
+    xs = jax.device_put(np.ones((4, 8), np.float32),
+                        jax.sharding.NamedSharding(mesh, P(None, "data")))
+    assert [f for f in tc.check_program(jax.jit(sm), (xs,), name="psum-scan")
+            if f.lint == "collective-in-scan"] == []
+    assert tc.check_collectives(jax.jit(sm), (xs,), name="psum-scan") == []
+
+
+def test_collective_lint_suppressible():
+    tok = tc.add_suppression("collective-in-scan", program="seeded")
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        P = jax.sharding.PartitionSpec
+        mesh = _dp_mesh()
+
+        def bad(xs):
+            def body(c, x):
+                return c + jnp.sum(jax.lax.all_gather(x, "data")), None
+            return jax.lax.scan(body, jnp.float32(0), xs)[0]
+
+        sm = shard_map(bad, mesh=mesh, in_specs=P(None, "data"),
+                       out_specs=P(), check_rep=False)
+        xs = jax.device_put(np.ones((4, 8), np.float32),
+                            jax.sharding.NamedSharding(mesh, P(None, "data")))
+        fs = [f for f in tc.check_program(jax.jit(sm), (xs,),
+                                          name="seeded-suppressed")
+              if f.lint == "collective-in-scan"]
+        assert fs and all(f.suppressed for f in fs)
+    finally:
+        tc.remove_suppression(tok)
