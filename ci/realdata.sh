@@ -1,0 +1,37 @@
+#!/bin/sh
+# Real-data input-tier smoke gate (docs/perf.md "Device-fed input
+# pipeline"): a small real-JPEG epoch through the full mxnet_tpu.data
+# tier — sharded reader -> 2 decode workers -> superbatch stack ->
+# prefetch-to-device -> fused K-step scan — must reach the
+# MXTPU_REALDATA_MIN_RATIO floor of the synthetic device-resident number
+# on the SAME model/batch/K, with zero tracecheck findings and populated
+# DataHealth/PipelineStats. bench.py exits nonzero below the floor; the
+# python block asserts the observability fields so a silent
+# instrumentation regression fails CI, not just a slow epoch.
+set -e
+cd "$(dirname "$0")/.."
+make -C src >/dev/null
+
+OUT=$(JAX_PLATFORMS=cpu BENCH_REAL_DATA=1 \
+      BENCH_RD_MODEL=lenet BENCH_RD_IMAGE=48 BENCH_RD_BATCH=32 \
+      BENCH_STEPS_PER_DISPATCH=2 BENCH_RD_IMAGES=128 \
+      BENCH_RD_MEASURE=4,12 MXTPU_DATA_WORKERS=2 BENCH_ROUNDS=1 \
+      python bench.py | tail -1)
+echo "$OUT"
+echo "$OUT" | python -c '
+import json, sys
+r = json.loads(sys.stdin.read())
+assert r["ratio"] >= r["min_ratio"], (r["ratio"], r["min_ratio"])
+assert r["tracecheck_findings"] == 0, r["tracecheck_findings"]
+p = r["pipeline"]
+for stage in ("read_s", "decode_s", "stack_s", "h2d_s"):
+    assert p.get(stage, 0) > 0, (stage, p)
+assert "stall_frac" in p and "queue_depth_avg" in p, p
+h = r["data_health"]
+for key in ("retries", "skipped_records", "failures"):
+    assert key in h, h
+assert r["workers"] == 2, r
+print("REALDATA SMOKE PASS: %.1f img/s, ratio %.3f (floor %.2f), "
+      "stall_frac %.3f" % (r["value"], r["ratio"], r["min_ratio"],
+                           p["stall_frac"]))
+'

@@ -20,6 +20,12 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
 # tracecheck findings on the serving program set (docs/serving.md)
 ./ci/serve.sh
+# real-data input-tier smoke (docs/perf.md "Device-fed input pipeline"):
+# small real-JPEG epoch through reader -> decode workers -> prefetch ->
+# fused scan; gates the real/synthetic throughput ratio floor
+# (MXTPU_REALDATA_MIN_RATIO), zero tracecheck findings, and populated
+# DataHealth/PipelineStats
+./ci/realdata.sh
 # multichip gate (docs/perf.md "Data-parallel scaling"): MEASURED — 8-device
 # fused-fit img/s + scaling efficiency vs 1 device (floor
 # MXTPU_MULTICHIP_MIN_EFF, default 0.7), guard + bitwise checkpoint/resume

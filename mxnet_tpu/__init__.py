@@ -29,6 +29,7 @@ from . import optimizer
 from . import metric
 from . import lr_scheduler
 from . import io
+from . import data
 from . import kvstore
 from . import kvstore as kv
 from . import callback

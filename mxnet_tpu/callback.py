@@ -62,6 +62,7 @@ class Speedometer(object):
         self.last_count = 0
         self._fired = 0
         self._stall_seen = 0.0  # pipeline host_stall at the last fire
+        self._data_stall_seen = 0.0  # input-tier stall at the last fire
         self._retrace_base = None  # tracecheck retrace count at init-fire
 
     @staticmethod
@@ -124,6 +125,25 @@ class Speedometer(object):
         return ("\tPipeline: depth=%d host_stall=%.3fs"
                 % (p.depth, window))
 
+    def _data_suffix(self, param):
+        """THIS run's input-tier window (docs/perf.md "Device-fed input
+        pipeline"): the seconds the training loop spent stalled waiting on
+        data since the last fire, plus the prefetch queue's average depth —
+        a growing stall with an empty queue is the input-bound signature.
+        Read strictly via ``param.locals`` like the other suffixes; empty
+        when the run has no instrumented input pipeline."""
+        loc = getattr(param, "locals", None)
+        st = loc.get("data_stats") if isinstance(loc, dict) else None
+        if st is None:
+            return ""
+        stall = st.stage_seconds("stall")
+        window = max(0.0, stall - self._data_stall_seen)
+        self._data_stall_seen = stall
+        rep = st.report()
+        q = rep.get("queue_depth_avg")
+        return ("\tData: stall=%.3fs q=%s"
+                % (window, "%.1f" % q if q is not None else "n/a"))
+
     def _retrace_suffix(self):
         """``Retraces: N`` once any watched jit entry has unexpectedly
         re-traced since this Speedometer started (docs/static_analysis.md):
@@ -154,6 +174,7 @@ class Speedometer(object):
                          / (time.time() - self.tic))
                 health = self._health_suffix(param) \
                     + self._pipeline_suffix(param) \
+                    + self._data_suffix(param) \
                     + self._retrace_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
@@ -173,11 +194,12 @@ class Speedometer(object):
             self.init = True
             self._fired = count
             self.tic = time.time()
-            # baseline the pipeline stall + retrace counters so the first
-            # fired window reports its own stall/misses, not the run-up —
-            # re-baselined on every (re-)init so a reused Speedometer never
-            # reports another run's cache misses
+            # baseline the pipeline/data stall + retrace counters so the
+            # first fired window reports its own stall/misses, not the
+            # run-up — re-baselined on every (re-)init so a reused
+            # Speedometer never reports another run's cache misses
             self._pipeline_suffix(param)
+            self._data_suffix(param)
             self._retrace_base = None
             self._retrace_suffix()
 

@@ -1,0 +1,35 @@
+"""mxnet_tpu.data: the device-fed input tier (docs/perf.md "Device-fed
+input pipeline").
+
+A first-class subsystem — peer to ``serving/`` and ``parallel/`` — that
+moves real-data input off the training loop's critical path, the gap the
+reference closed with its threaded RecordIO pipeline (arXiv:1512.01274)
+and TensorFlow with its overlapped prefetching input stage
+(arXiv:1605.08695):
+
+- :mod:`~mxnet_tpu.data.reader` — shard-aware indexed RecordIO reading
+  (host ``part_index/num_parts`` plus per-chip sub-sharding) with
+  deterministic pure-function epoch shuffling, riding the PR 2
+  retry/corrupt-skip/DataHealth stack.
+- :mod:`~mxnet_tpu.data.workers` — N decode/augment workers over a work
+  queue with bounded output, deterministic batch reassembly order, and
+  dead-worker detection that fails the consumer instead of hanging.
+- :mod:`~mxnet_tpu.data.prefetch` — the device prefetcher landing each
+  stacked superbatch (per-chip sharded under a data mesh) ahead of fit's
+  depth-D dispatch pipeline.
+- :mod:`~mxnet_tpu.data.stats` — per-stage ``PipelineStats``
+  (read/decode/stack/H2D seconds, queue depths, stall fractions) mirrored
+  into the process-global :data:`~mxnet_tpu.data.stats.PIPELINE_STATS`.
+
+``image.ImageRecordIter(num_workers=)`` / ``image.ImageIter(num_workers=)``
+are the user-facing spellings; ``Module.fit`` wires the prefetcher in
+automatically for fused K-step runs.
+"""
+from . import stats
+from . import reader
+from . import workers
+from . import prefetch
+from .stats import PipelineStats, PIPELINE_STATS
+from .reader import ShardedRecordReader
+from .workers import DecodeWorkerPool, default_num_workers
+from .prefetch import DevicePrefetcher

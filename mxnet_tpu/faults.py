@@ -46,6 +46,17 @@ site                         where it fires
                              — ``"die"`` (or any raising kind) kills the
                              loop thread, which sheds every in-flight and
                              queued sequence with ``ServingClosedError``
+``data.worker_die``          per claimed batch task in a
+                             ``data.DecodeWorkerPool`` worker — ``"die"``
+                             kills that worker abruptly (no sentinel); the
+                             consumer's dead-worker detector fails the
+                             training loop promptly with an ``MXNetError``
+                             naming the site instead of hanging
+``data.decode_delay``        per batch task before the decode stage — a
+                             ``"delay"`` rule makes that worker slow,
+                             which surfaces as consumer stall fraction in
+                             ``data.PipelineStats`` without ever
+                             reordering batches
 ===========================  ==============================================
 
 Rule kinds:

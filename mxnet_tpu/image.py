@@ -5,11 +5,18 @@ Decode uses Pillow (OpenCV is absent from the TPU image); augmenters are
 numpy-based host-side transforms. The ImageRecordIter-style high-throughput
 path (threaded decode, RecordIO shards, part_index/num_parts sharding) is in
 ImageIter below over mxnet_tpu.recordio.
+
+``num_workers=`` on either iterator routes decoding through the
+``mxnet_tpu.data`` worker pool (docs/perf.md "Device-fed input pipeline"):
+N decode/augment workers over a shard-aware reader with deterministic
+epoch shuffling and batch order — the sample stream is identical for any
+worker count, which is what keeps resume fast-forward bitwise-correct.
 """
 from __future__ import annotations
 
 import io as _io
 import os
+import time
 
 import numpy as np
 
@@ -179,18 +186,96 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+class _PoolRunner(object):
+    """Per-iterator driver of :class:`~mxnet_tpu.data.DecodeWorkerPool`:
+    owns the absolute-epoch cursor, builds each epoch's batch task list
+    (keys + pure-function batch seed + pad), and hands batches back in
+    deterministic order. One pool instance per epoch pass — a mid-epoch
+    reset can never leak half-decoded batches forward."""
+
+    def __init__(self, make_tasks, batch_fn, num_workers, stats, name):
+        self._make_tasks = make_tasks   # epoch -> (tasks, pads)
+        self._batch_fn = batch_fn
+        self.num_workers = int(num_workers)
+        self.stats = stats
+        self._name = name
+        self._pool = None
+        self._pads = []
+        self._emit = 0
+        self.epoch = -1
+
+    def start_epoch(self, epoch):
+        """Position on ``epoch``. LAZY: the pool (worker threads + decode
+        -ahead) spawns on the first :meth:`next`, so constructing or
+        re-positioning an iterator costs nothing — a resumed launch's
+        ``set_epoch(E)`` never throws away eagerly-decoded epoch-0
+        batches, and fit's final-epoch reset leaves no live threads or
+        pinned batches behind."""
+        self.close()
+        self.epoch = int(epoch)
+        self._emit = 0
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            from . import data as _data
+            tasks, self._pads = self._make_tasks(self.epoch)
+            self._pool = _data.DecodeWorkerPool(
+                self._batch_fn, tasks, self.num_workers, stats=self.stats,
+                name=self._name)
+
+    @property
+    def consumed(self):
+        return self._emit
+
+    def next(self):
+        """((data, labels), pad) for the next batch in order; raises
+        StopIteration at epoch end, MXNetError on a dead worker."""
+        if self.epoch < 0:
+            raise MXNetError("%s: reset() before iterating" % self._name)
+        self._ensure_pool()
+        payload = self._pool.next_batch()
+        pad = self._pads[self._emit] if self._emit < len(self._pads) else 0
+        self._emit += 1
+        return payload, pad
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
+
+
+def _pure_batch_seed(seed, epoch, batch_index):
+    """Per-batch augmentation seed as a PURE function of (iterator seed,
+    absolute epoch, batch index): which worker decodes a batch — and in
+    what order batches complete — can never perturb the augmentation
+    stream, and a resumed run re-derives epoch E's exact stream."""
+    return (int(seed) * 1000003 + (int(epoch) + 1) * 10007
+            + int(batch_index) + 1) % (1 << 62)
+
+
 class ImageIter(mxio.DataIter):
     """Image iterator over RecordIO or an image list
     (ref: image.py ImageIter; C++ ImageRecordIter at
     src/io/iter_image_recordio_2.cc). Supports part_index/num_parts sharding
-    for data-parallel hosts."""
+    for data-parallel hosts.
+
+    ``num_workers >= 1`` (default: env ``MXTPU_DATA_WORKERS``, 0 = the
+    legacy in-line path) decodes through the ``mxnet_tpu.data`` worker
+    pool: deterministic pure-function epoch shuffling (seeded by
+    ``seed``), per-batch augmentation RNG scoped to (seed, epoch, batch),
+    and batch reassembly in strict order — the sample stream is identical
+    for every worker count. With ``skip_corrupt`` the pool path keeps
+    batch boundaries FIXED (corrupt slots are backfilled with the nearest
+    good sample in the batch and counted in DataHealth) where the legacy
+    path shifts subsequent batches; corruption-free epochs are identical
+    across both paths for ``shuffle=False``."""
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name="data", label_name="softmax_label",
                  retry_policy=None, skip_corrupt=False, data_health=None,
-                 **kwargs):
+                 num_workers=None, seed=0, **kwargs):
         super().__init__(batch_size)
         assert len(data_shape) == 3
         # fault tolerance (docs/robustness.md): transient read failures are
@@ -206,6 +291,7 @@ class ImageIter(mxio.DataIter):
         self.path_root = path_root
         self.record = None
         self.imglist = None
+        self._orig_part = (part_index, num_parts)
         if path_imgrec is not None:
             self.record, self.seq = _open_sharded_record(
                 path_imgrec, part_index, num_parts)
@@ -237,6 +323,27 @@ class ImageIter(mxio.DataIter):
         self.data_name = data_name
         self.label_name = label_name
         self.cur = 0
+        # device-fed input tier (docs/perf.md "Device-fed input pipeline")
+        from . import data as _data
+        self.seed = int(seed)
+        self.data_stats = _data.PipelineStats(parent=_data.PIPELINE_STATS)
+        self.num_workers = int(num_workers if num_workers is not None
+                               else _data.default_num_workers())
+        self._runner = None
+        self._reader = None
+        self._abs_epoch = -1
+        if self.num_workers > 0:
+            self._base_seq = list(self.seq)  # pristine pre-shuffle order
+            if self.record is not None:
+                # thread-safe shard-aware reads + pure epoch shuffling
+                self._reader = _data.ShardedRecordReader(
+                    path_imgrec, part_index=self._orig_part[0],
+                    num_parts=self._orig_part[1], shuffle=shuffle,
+                    seed=self.seed, retry_policy=self.retry_policy,
+                    data_health=self.data_health)
+            self._runner = _PoolRunner(
+                self._make_epoch_tasks, self._pool_batch_fn,
+                self.num_workers, self.data_stats, "ImageIter")
         self.reset()
 
     @property
@@ -251,9 +358,121 @@ class ImageIter(mxio.DataIter):
         return [mxio.DataDesc(self.label_name, shape)]
 
     def reset(self):
+        if self._runner is not None:
+            self._abs_epoch += 1
+            self._runner.start_epoch(self._abs_epoch)
+            return
         if self.shuffle:
             _random.np_rng().shuffle(self.seq)
         self.cur = 0
+
+    @property
+    def data_epoch(self):
+        """Absolute epoch the pool path currently sits on (None on the
+        legacy path) — DevicePrefetcher.set_epoch's no-op check."""
+        return self._abs_epoch if self._runner is not None else None
+
+    def set_epoch(self, epoch):
+        """Pin the iterator to absolute epoch ``epoch`` (pure-function
+        shuffle order + augmentation seeds): a resumed or rolled-back run
+        re-derives exactly the order the original run trained.
+        ``Module.fit`` calls this; no-op on the legacy (num_workers=0)
+        path, whose in-place shuffle has no epoch addressing."""
+        if self._runner is None:
+            return
+        if self._runner.epoch == int(epoch) and self._runner.consumed == 0:
+            return  # already positioned; keep the decoded-ahead batches
+        self._abs_epoch = int(epoch)
+        self._runner.start_epoch(self._abs_epoch)
+
+    def close(self):
+        """Stop the decode workers and release reader handles."""
+        if self._runner is not None:
+            self._runner.close()
+        if self._reader is not None:
+            self._reader.close()
+
+    # -- worker-pool path (mxnet_tpu.data) ------------------------------
+    def _epoch_order(self, epoch):
+        if self._reader is not None:
+            return self._reader.epoch_order(epoch)
+        if not self.shuffle:
+            return list(self._base_seq)
+        # imglist mode: the reader's exact shuffle recipe over the same
+        # shard (one pure function for the whole tier)
+        from .data.reader import epoch_permutation
+        return epoch_permutation(self.seed, epoch, self._base_seq)
+
+    def _make_epoch_tasks(self, epoch):
+        order = self._epoch_order(epoch)
+        bs = self.batch_size
+        tasks = [(order[b * bs:(b + 1) * bs],
+                  _pure_batch_seed(self.seed, epoch, b))
+                 for b in range(len(order) // bs)]
+        return tasks, [0] * len(tasks)  # partial tail dropped (legacy)
+
+    def _pool_read_raw(self, key):
+        """(label, img bytes) for the pool path — reads ride the reader's
+        thread-local handles (RecordIO) or per-read file opens (imglist),
+        both under the ``io.record_read`` retry policy."""
+        if self._reader is not None:
+            header, img = self._reader.read(key)
+            return header.label, img
+        label, fname = self.imglist[key]
+
+        def rd():
+            from . import faults as _faults
+            _faults.fire("io.record_read")
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                return f.read()
+
+        return label, mxio.retry_call(rd, "io.record_read",
+                                      self.retry_policy, self.data_health)
+
+    def _pool_batch_fn(self, keys, batch_seed):
+        """Decode one batch task on a worker thread. Augmentation draws
+        come from a Generator scoped to this batch's pure seed, so the
+        stream is identical for every worker count. With ``skip_corrupt``,
+        corrupt slots backfill from the nearest good sample in the SAME
+        batch (boundaries never shift); a fully-corrupt batch raises."""
+        bs = len(keys)
+        data = np.zeros((bs,) + self.data_shape, np.float32)
+        labels = np.zeros((bs, self.label_width), np.float32)
+        good = []
+        bad = []
+        with _random.scoped_np_rng(np.random.default_rng(
+                np.random.SeedSequence(batch_seed))):
+            for i, key in enumerate(keys):
+                try:
+                    t0 = time.perf_counter()
+                    label, img_bytes = self._pool_read_raw(key)
+                    self.data_stats.add("read", time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    img = self._decode_aug(key, img_bytes)
+                    self.data_stats.add("decode",
+                                        time.perf_counter() - t0)
+                except mxio.CorruptRecordError as e:
+                    if not self.skip_corrupt:
+                        raise
+                    self.data_health.record_skip("io.record_read", e)
+                    import logging
+                    logging.warning("ImageIter: skipping %s", e)
+                    bad.append(i)
+                    continue
+                data[i] = img
+                labels[i] = np.asarray(
+                    label, np.float32).reshape(-1)[:self.label_width]
+                good.append(i)
+        if bad:
+            if not good:
+                raise mxio.CorruptRecordError(
+                    "ImageIter: every record in batch is corrupt "
+                    "(keys %r...)" % (keys[:4],))
+            for i in bad:
+                j = max((g for g in good if g < i), default=good[0])
+                data[i] = data[j]
+                labels[i] = labels[j]
+        return data, labels
 
     def _read_raw(self, key):
         """The IO phase: record/file bytes + label. Transient failures here
@@ -279,28 +498,36 @@ class ImageIter(mxio.DataIter):
         with open(os.path.join(self.path_root, fname), "rb") as f:
             return label, f.read()
 
-    def _read_one(self, key):
-        label, img_bytes = mxio.retry_call(
-            lambda: self._read_raw(key), "io.record_read",
-            self.retry_policy, self.data_health)
+    def _decode_aug(self, key, img_bytes):
+        """Decode + augment + HWC->CHW for one record's bytes; undecodable
+        bytes classify as :class:`~mxnet_tpu.io.CorruptRecordError`
+        (permanent — retrying cannot help)."""
         try:
             img = imdecode(img_bytes).asnumpy()
         except Exception as e:
-            # undecodable bytes are permanent: retrying cannot help
             raise mxio.CorruptRecordError(
                 "corrupt image record %r: %s: %s"
                 % (key, type(e).__name__, e))
         for aug in self.aug_list:
             img = aug(img)
-        # HWC -> CHW
-        img = np.transpose(img.astype(np.float32), (2, 0, 1))
-        return img, label
+        return np.transpose(img.astype(np.float32), (2, 0, 1))
+
+    def _read_one(self, key):
+        label, img_bytes = mxio.retry_call(
+            lambda: self._read_raw(key), "io.record_read",
+            self.retry_policy, self.data_health)
+        return self._decode_aug(key, img_bytes), label
 
     def next_host(self):
         """One batch as host numpy (no device transfer). This is the
         superbatch hook: ``io.SuperBatchIter`` stacks K of these on its
         prefetch thread and lands the whole (k, batch, ...) stack on device
         as ONE H2D transfer."""
+        if self._runner is not None:
+            (data, labels), _pad = self._runner.next()
+            label_arr = labels[:, 0] if self.label_width == 1 else labels
+            return mxio.DataBatch(data=[data], label=[label_arr],
+                                  pad=0, index=None)
         if self.cur + self.batch_size > len(self.seq):
             raise StopIteration
         data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
@@ -356,6 +583,18 @@ class ImageRecordIter(mxio.DataIter):
     data_shape (C,H,W), batch_size, shuffle, rand_crop, rand_mirror,
     resize (short edge), mean_r/g/b, std_r/g/b, label_width,
     part_index/num_parts (host sharding), preprocess_threads, seed.
+
+    ``num_workers >= 1`` (default: env ``MXTPU_DATA_WORKERS``, 0 = the
+    legacy single-prefetch path) is the device-fed input tier (docs/perf.md
+    "Device-fed input pipeline"): N decode workers over the
+    ``mxnet_tpu.data`` pool, shard-aware reads with thread-local handles,
+    PURE-function epoch shuffling (epoch order and per-batch augmentation
+    seeds depend only on (seed, epoch, batch index) — resumable and
+    identical for every worker count), host-numpy batches via
+    ``next_host()`` so the superbatch prefetcher lands one (sharded) H2D
+    per dispatch, and per-stage ``PipelineStats`` in ``data_stats``.
+    ``sub_index/sub_parts`` sub-shard within the host shard (per-chip
+    loading for the data mesh).
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
@@ -364,7 +603,9 @@ class ImageRecordIter(mxio.DataIter):
                  std_r=1.0, std_g=1.0, std_b=1.0,
                  part_index=0, num_parts=1, preprocess_threads=None,
                  prefetch=True, seed=0, round_batch=True,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label",
+                 num_workers=None, sub_index=0, sub_parts=1,
+                 retry_policy=None, data_health=None, **kwargs):
         super().__init__(batch_size)
         lib = _native_lib()
         if lib is None:
@@ -377,6 +618,14 @@ class ImageRecordIter(mxio.DataIter):
         self.label_width = label_width
         self._rec, self.seq = _open_sharded_record(path_imgrec, part_index,
                                                    num_parts)
+        if sub_parts > 1:
+            # per-chip sub-shard within the host shard (the PR 7 data-mesh
+            # feeder layout) — same validated arithmetic as the pool
+            # path's reader, so an out-of-range sub_index raises instead
+            # of silently training that chip on an empty shard
+            from .data.reader import _shard
+            self.seq = _shard(self.seq, sub_index, sub_parts,
+                              "%r sub_parts" % path_imgrec)
         self.round_batch = round_batch
         self.shuffle = shuffle
         self.rand_crop = rand_crop
@@ -386,8 +635,16 @@ class ImageRecordIter(mxio.DataIter):
         self._std = np.array([std_r, std_g, std_b], np.float32)
         self._use_mean = any(v != 0.0 for v in (mean_r, mean_g, mean_b))
         self._use_std = any(v != 1.0 for v in (std_r, std_g, std_b))
+        from . import data as _data
+        self.num_workers = int(num_workers if num_workers is not None
+                               else _data.default_num_workers())
         if preprocess_threads is None:
-            preprocess_threads = min(16, os.cpu_count() or 1)
+            # the native decoder threads multiply with the pool workers:
+            # split the cores instead of oversubscribing num_workers-fold
+            cores = os.cpu_count() or 1
+            preprocess_threads = (max(1, cores // self.num_workers)
+                                  if self.num_workers > 0
+                                  else min(16, cores))
         self.preprocess_threads = preprocess_threads
         self._seed = seed
         self._epoch = 0
@@ -397,7 +654,22 @@ class ImageRecordIter(mxio.DataIter):
         self._prefetch = prefetch
         self._pending = None  # in-flight decode future
         self._pool = None
-        if prefetch:
+        self.data_stats = _data.PipelineStats(parent=_data.PIPELINE_STATS)
+        self.data_health = (data_health if data_health is not None
+                            else mxio.DataHealth(parent=mxio.DATA_HEALTH))
+        self._runner = None
+        self._reader = None
+        self._abs_epoch = -1
+        if self.num_workers > 0:
+            self._reader = _data.ShardedRecordReader(
+                path_imgrec, part_index=part_index, num_parts=num_parts,
+                sub_index=sub_index, sub_parts=sub_parts, shuffle=shuffle,
+                seed=seed, retry_policy=retry_policy,
+                data_health=self.data_health)
+            self._runner = _PoolRunner(
+                self._make_epoch_tasks, self._pool_batch_fn,
+                self.num_workers, self.data_stats, "ImageRecordIter")
+        elif prefetch:
             import concurrent.futures
             self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
         self.reset()
@@ -415,12 +687,73 @@ class ImageRecordIter(mxio.DataIter):
 
     def reset(self):
         self._epoch += 1
+        if self._runner is not None:
+            self._abs_epoch += 1
+            self._runner.start_epoch(self._abs_epoch)
+            return
         if self.shuffle:
             rng = np.random.default_rng(self._seed + self._epoch)
             rng.shuffle(self.seq)
         self.cur = 0
         self._pending = None
 
+    @property
+    def data_epoch(self):
+        """Absolute epoch the pool path currently sits on (None on the
+        legacy path) — DevicePrefetcher.set_epoch's no-op check."""
+        return self._abs_epoch if self._runner is not None else None
+
+    def set_epoch(self, epoch):
+        """Pin the iterator to absolute epoch ``epoch``: the pool path
+        re-derives that epoch's pure-function shuffle order and
+        augmentation seeds, making mid-schedule resume (and divergence
+        rollback) bitwise-reproducible. No-op on the legacy path, whose
+        cumulative in-place shuffle has no epoch addressing."""
+        if self._runner is None:
+            return
+        if self._runner.epoch == int(epoch) and self._runner.consumed == 0:
+            return  # already positioned; keep the decoded-ahead batches
+        self._abs_epoch = int(epoch)
+        self._runner.start_epoch(self._abs_epoch)
+
+    def close(self):
+        """Stop decode workers and release reader handles (idempotent)."""
+        if self._runner is not None:
+            self._runner.close()
+        if self._reader is not None:
+            self._reader.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+            self._pending = None
+
+    # -- worker-pool path (mxnet_tpu.data) ------------------------------
+    def _make_epoch_tasks(self, epoch):
+        order = self._reader.epoch_order(epoch)
+        bs = self.batch_size
+        tasks, pads = [], []
+        nfull = len(order) // bs
+        for b in range(nfull):
+            tasks.append((order[b * bs:(b + 1) * bs],
+                          _pure_batch_seed(self._seed, epoch, b)))
+            pads.append(0)
+        rem = len(order) - nfull * bs
+        if rem and self.round_batch:
+            # wrap the tail with records from the epoch start, reported as
+            # pad (ref: ImageRecordIter round_batch) — same rule as the
+            # legacy path's _next_keys
+            keys = order[nfull * bs:] + order[:bs - rem]
+            tasks.append((keys, _pure_batch_seed(self._seed, epoch, nfull)))
+            pads.append(bs - rem)
+        return tasks, pads
+
+    def _pool_batch_fn(self, keys, batch_seed):
+        t0 = time.perf_counter()
+        recs = [self._reader.read(k) for k in keys]
+        self.data_stats.add("read", time.perf_counter() - t0, n=len(keys))
+        return self._decode_records(recs, batch_seed)
+
+    # -- decode ---------------------------------------------------------
     def decode_batch_numpy(self, keys, batch_seed):
         """Read + fused native decode/augment for the given record keys;
         returns host numpy (data, labels). This is the stage that scales
@@ -436,15 +769,25 @@ class ImageRecordIter(mxio.DataIter):
         return array(out), array(label_arr)
 
     def _decode_batch_np(self, keys, batch_seed):
-        import ctypes
-        n = len(keys)
+        t0 = time.perf_counter()
         raws = [self._rec.read_idx(k) for k in keys]
+        recs = [recordio.unpack(s) for s in raws]
+        self.data_stats.add("read", time.perf_counter() - t0, n=len(keys))
+        return self._decode_records(recs, batch_seed)
+
+    def _decode_records(self, recs, batch_seed):
+        """Fused native decode/augment over already-read (header, bytes)
+        pairs — the shared decode stage for the legacy path (which reads
+        through the iterator's own handle) and the worker pool (which
+        reads through the shard reader's thread-local handles)."""
+        import ctypes
+        t_dec = time.perf_counter()
+        n = len(recs)
         labels = np.zeros((n, self.label_width), np.float32)
         bufs = (ctypes.POINTER(ctypes.c_uint8) * n)()
         sizes = (ctypes.c_uint64 * n)()
         holders = []
-        for i, s in enumerate(raws):
-            header, img = recordio.unpack(s)
+        for i, (header, img) in enumerate(recs):
             lab = np.asarray(header.label, np.float32).reshape(-1)
             labels[i, :] = lab[:self.label_width]
             holder = np.frombuffer(img, np.uint8)
@@ -468,9 +811,12 @@ class ImageRecordIter(mxio.DataIter):
             bad = int(np.sum(status == 0))
             raise MXNetError("ImageRecordIter: %d corrupt image(s) in batch"
                              % bad)
+        self.data_stats.add("decode", time.perf_counter() - t_dec, n=n)
         return out, labels
 
-    def _submit(self):
+    def _next_keys(self):
+        """Advance the legacy cursor one batch: (keys, batch_seed, pad) or
+        None at epoch end."""
         remaining = len(self.seq) - self.cur
         if remaining <= 0 or (remaining < self.batch_size
                               and not self.round_batch):
@@ -486,12 +832,47 @@ class ImageRecordIter(mxio.DataIter):
         self._batch_counter += 1
         batch_seed = (self._seed * 1000003 + self._epoch * 10007
                       + self._batch_counter)
+        return keys, batch_seed, pad
+
+    def _submit(self):
+        task = self._next_keys()
+        if task is None:
+            return None
+        keys, batch_seed, pad = task
         if self._pool is not None:
             return (self._pool.submit(self._decode_batch, keys, batch_seed),
                     pad)
         return (keys, batch_seed, pad)
 
+    def next_host(self):
+        """One batch as host numpy (no device transfer) — the superbatch
+        hook: ``DevicePrefetcher``/``SuperBatchIter`` stacks K of these on
+        the producer thread and lands the whole (k, batch, ...) stack as
+        ONE (optionally per-chip sharded) H2D."""
+        if self._runner is not None:
+            (out, labels), pad = self._runner.next()
+            label_arr = labels[:, 0] if self.label_width == 1 else labels
+            return mxio.DataBatch(data=[out], label=[label_arr],
+                                  pad=pad, index=None)
+        if self._pending is not None:
+            raise MXNetError(
+                "ImageRecordIter: cannot mix next() and next_host() — a "
+                "device-prefetched batch is already in flight")
+        task = self._next_keys()
+        if task is None:
+            raise StopIteration
+        keys, batch_seed, pad = task
+        out, labels = self._decode_batch_np(keys, batch_seed)
+        label_arr = labels[:, 0] if self.label_width == 1 else labels
+        return mxio.DataBatch(data=[out], label=[label_arr],
+                              pad=pad, index=None)
+
     def next(self):
+        if self._runner is not None:
+            batch = self.next_host()
+            return mxio.DataBatch(data=[array(a) for a in batch.data],
+                                  label=[array(a) for a in batch.label],
+                                  pad=batch.pad, index=None)
         if self._pending is None:
             self._pending = self._submit()
         if self._pending is None:

@@ -543,6 +543,11 @@ class SuperBatchIter(DataIter):
             return None
         return group
 
+    def _note_stage(self, stage, seconds, n=1):
+        """Per-stage timing hook (stack / h2d), a no-op here; the input
+        tier's :class:`~mxnet_tpu.data.prefetch.DevicePrefetcher` overrides
+        it to charge :class:`~mxnet_tpu.data.stats.PipelineStats`."""
+
     def _stack(self, parts):
         """One stacked array per slot; host parts take a single np.stack +
         device put (ONE H2D for the whole superbatch slot), device parts
@@ -554,7 +559,9 @@ class SuperBatchIter(DataIter):
         from . import faults as _faults
         raw = [p.data if isinstance(p, NDArray) else p for p in parts]
         if all(isinstance(r, np.ndarray) for r in raw):
+            t0 = time.perf_counter()
             stacked = np.stack(raw)
+            self._note_stage("stack", time.perf_counter() - t0)
 
             def land():
                 _faults.fire("io.h2d")
@@ -569,13 +576,20 @@ class SuperBatchIter(DataIter):
                     return NDArray(jax.device_put(src, self.sharding))
                 return array(stacked)
 
-            return retry_call(land, "io.h2d", self.retry_policy,
-                              self.data_health)
+            t0 = time.perf_counter()
+            try:
+                return retry_call(land, "io.h2d", self.retry_policy,
+                                  self.data_health)
+            finally:
+                self._note_stage("h2d", time.perf_counter() - t0,
+                                 n=len(parts))
         import jax.numpy as jnp
+        t0 = time.perf_counter()
         out = jnp.stack([jnp.asarray(r) for r in raw])
         if self.sharding is not None:
             import jax
             out = jax.device_put(out, self.sharding)
+        self._note_stage("h2d", time.perf_counter() - t0, n=len(parts))
         return NDArray(out)
 
     def _assemble(self, group):

@@ -400,16 +400,30 @@ class BaseModule(object):
             pl_depth = 0
         pipeline = _DispatchPipeline(pl_depth)
         if k > 1:
-            # data-parallel mesh: hand the superbatch producer the batch-axis
+            # device-fed input tier (docs/perf.md "Device-fed input
+            # pipeline"): the prefetcher stacks K host batches per dispatch
+            # and lands them D+1 deep ahead of the depth-D dispatch
+            # pipeline, charging stack/H2D/stall to the pipeline's
+            # PipelineStats. A data-parallel mesh hands it the batch-axis
             # sharding so every stacked array LANDS per-chip sharded — the
             # one H2D is the scatter, and the dispatch loop never pays a
             # resharding copy (docs/perf.md "Data-parallel scaling")
             sb_sharding = getattr(self, "_superbatch_sharding", None)
-            train_iter = train_data.superbatch(
-                k, queue_depth=max(2, pl_depth + 1),
+            from .. import data as _data
+            train_iter = _data.DevicePrefetcher(
+                train_data, k, depth=pl_depth,
                 sharding=sb_sharding() if sb_sharding is not None else None)
         else:
             train_iter = train_data
+        # deterministic resume through shuffling iterators: pin the data
+        # order to the ABSOLUTE epoch — a fresh process resuming at epoch E
+        # must re-derive epoch E's shuffle, not epoch 0's (iterators
+        # without epoch-addressable order ignore this)
+        iter_set_epoch = getattr(train_iter, "set_epoch", None)
+        if iter_set_epoch is not None:
+            iter_set_epoch(begin_epoch)
+        data_stats = (getattr(train_iter, "stats", None)
+                      or getattr(train_iter, "data_stats", None))
 
         note_retired = getattr(self, "_note_dispatch_retired", None)
 
@@ -434,7 +448,8 @@ class BaseModule(object):
                     cb_params = BatchEndParam(
                         epoch=epoch, nbatch=nb, eval_metric=eval_metric,
                         locals={"guard": guard, "pipeline": pipeline,
-                                "eval_metric": eval_metric, "self": self})
+                                "eval_metric": eval_metric, "self": self,
+                                "data_stats": data_stats})
                     for callback in _as_list(batch_end_callback):
                         callback(cb_params)
 
@@ -581,6 +596,10 @@ class BaseModule(object):
                     resume_state = self._guard_rollback(guard, ckpt_mgr)
                     epoch = resume_state.epoch
                     train_iter.reset()
+                    if iter_set_epoch is not None:
+                        # the rollback rewinds the epoch cursor: re-pin the
+                        # data order (reset() alone advances it by one)
+                        iter_set_epoch(epoch)
                     continue
 
                 for name, val in eval_metric.get_name_value():

@@ -623,8 +623,12 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
         ex = self._exec_group.executor
         params = {n: self._jnp_copy(ex.arg_dict[n].data)
                   for n in self._fused.param_names}
-        aux = {n: self._jnp_copy(ex.aux_dict[n].data)
-               for n in self._fused.aux_names}
+        # MXTPU_BF16_STATS: moving stats store bf16 inside the fused state
+        # (executor arrays and checkpoints stay f32 — the cast back on
+        # sync is exact, so resume stays bitwise)
+        aux = self._fused.cast_stats(
+            {n: self._jnp_copy(ex.aux_dict[n].data)
+             for n in self._fused.aux_names})
         if prev is not None:
             opt_state = prev["opt"]
             step = prev["step"]  # host mirror already tracks it
@@ -662,7 +666,7 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
                 out[n] = to_jnp(states[idx])
             else:
                 out[n] = self._optimizer.create_fused_state(v)
-        return out
+        return self._fused.cast_opt_state(out)
 
     def _try_fused_fit_step(self, data_batch, guard=None):
         """fit()'s fast path: one donated jit for fwd+bwd+update. Returns
@@ -849,8 +853,14 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
             ex.arg_dict[n]._set_data(
                 self._jnp_copy(self._fused_state["params"][n]))
         for n in self._fused.aux_names:
-            ex.aux_dict[n]._set_data(
-                self._jnp_copy(self._fused_state["aux"][n]))
+            v = self._jnp_copy(self._fused_state["aux"][n])
+            tgt = ex.aux_dict[n].data.dtype
+            if v.dtype != tgt:
+                # bf16 moving stats (MXTPU_BF16_STATS) widen back to the
+                # executor's f32 — exact, so checkpoints/score() see the
+                # same values the fused state trains with
+                v = v.astype(tgt)
+            ex.aux_dict[n]._set_data(v)
         self._fused_dirty = False
 
     def _sync_fused_opt_states(self):
@@ -868,7 +878,14 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
                 return None
             if isinstance(x, tuple):
                 return tuple(to_nd(i) for i in x)
-            return NDArray(self._jnp_copy(x))
+            v = self._jnp_copy(x)
+            if str(v.dtype) == "bfloat16":
+                # bf16 optimizer state (MXTPU_BF16_STATS=opt) serializes
+                # f32: save formats stay unchanged and the bf16->f32->bf16
+                # round trip is exact, so resume stays bitwise
+                import jax.numpy as jnp
+                v = v.astype(jnp.float32)
+            return NDArray(v)
 
         idx_of = {n: i for i, n in enumerate(self._exec_group.param_names)}
         for n, st in self._fused_state["opt"].items():

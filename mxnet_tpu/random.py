@@ -58,11 +58,45 @@ def set_state(key):
         _key = key
 
 
+_tls = threading.local()
+
+
 def np_rng():
     """A numpy Generator seeded from the functional stream (host-side uses:
-    data shuffling, initializers that want numpy)."""
+    data shuffling, initializers that want numpy).
+
+    Inside a :func:`scoped_np_rng` block the scoped Generator is returned
+    instead — the device-fed input tier's decode workers pin each batch's
+    augmentation draws to a Generator derived from (seed, epoch, batch
+    index), so worker parallelism and completion order never perturb the
+    augmentation stream (docs/perf.md "Device-fed input pipeline")."""
+    ov = getattr(_tls, "np_rng", None)
+    if ov is not None:
+        return ov
     sub = split()
     return _np.random.default_rng(_np.asarray(jax.random.key_data(sub))[-1])
+
+
+class scoped_np_rng(object):
+    """Thread-local override of :func:`np_rng` for the calling thread:
+
+        with random.scoped_np_rng(np.random.default_rng(s)):
+            ...   # every np_rng() here returns that Generator
+
+    Scopes nest; the override never leaks to other threads (each decode
+    worker scopes its own batch) nor past the block."""
+
+    def __init__(self, rng):
+        self._rng = rng
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "np_rng", None)
+        _tls.np_rng = self._rng
+        return self._rng
+
+    def __exit__(self, *exc):
+        _tls.np_rng = self._prev
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -218,6 +218,13 @@ class MXRecordIO(object):
         self.open()
 
     def tell(self):
+        """Current byte offset. In write mode the buffered handle is
+        flushed first so the returned offset is DURABLE — an index entry
+        recorded from it (``write_idx``) stays exact even if a reader
+        opens the file while the writer is still live (the sharded
+        reader's thread-local handles depend on exact offsets)."""
+        if self.writable:
+            self.handle.flush()
         return self.handle.tell()
 
     def write(self, buf):
@@ -232,6 +239,21 @@ class MXRecordIO(object):
     def read(self):
         assert not self.writable
         offset = self.handle.tell()
+        try:
+            return self._read_at(offset)
+        except Exception:
+            # partial-read consistency: a failed read (truncated record,
+            # bad magic) must not leave the handle mid-record — seek back
+            # to the record start so tell() stays meaningful, a subsequent
+            # seek()/read_idx() of a GOOD key works, and re-reading this
+            # offset fails the same way instead of parsing garbage
+            try:
+                self.handle.seek(offset)
+            except Exception:
+                pass
+            raise
+
+    def _read_at(self, offset):
         head = self.handle.read(4)
         if len(head) < 4:
             if head:

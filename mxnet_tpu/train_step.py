@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, env_str
 from .executor import _build_graph_runner
 from .initializer import Xavier, InitDesc
 from .ndarray import NDArray
@@ -213,6 +213,17 @@ class TrainStep(object):
         self.mesh = mesh
         self.param_shardings = dict(param_shardings or {})
         self.dtype = np.dtype(dtype)
+        # MXTPU_BF16_STATS (docs/perf.md next-steps item 2): store the
+        # NON-parameter state in bf16 — any truthy value keeps BatchNorm
+        # moving stats (aux states) in bf16; "opt"/"all" additionally
+        # keeps optimizer state (momentum/Adam moments) in bf16. Halves
+        # the non-param state traffic on a bandwidth-bound chip; params
+        # keep f32 masters (bf16 params measured -12%, docs/perf.md r5).
+        # Checkpoints still serialize f32 (bf16->f32->bf16 is exact), so
+        # resume stays bitwise and save formats are unchanged.
+        _bf16 = env_str("MXTPU_BF16_STATS").lower()
+        self.bf16_stats = _bf16 not in ("", "0", "false", "off", "no")
+        self.bf16_opt = _bf16 in ("opt", "all", "full")
         if compute_dtype is not None:
             self.compute_dtype = np.dtype(compute_dtype)
         elif self.dtype != np.dtype(np.float32):
@@ -321,16 +332,37 @@ class TrainStep(object):
         finally:
             _random.set_state(saved)
         opt = self._init_opt_state(params)
-        state = {"params": params, "aux": aux, "opt": opt,
+        state = {"params": params, "aux": self.cast_stats(aux), "opt": opt,
                  "step": jnp.zeros((), jnp.int32)}
         if self.mesh is not None:
             state = self._shard_state(state)
         return state
 
+    def cast_stats(self, aux):
+        """MXTPU_BF16_STATS: aux (BatchNorm moving stats) storage cast —
+        identity when the knob is off."""
+        if not self.bf16_stats:
+            return aux
+        return {n: (v.astype(jnp.bfloat16)
+                    if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                    else v)
+                for n, v in aux.items()}
+
+    def cast_opt_state(self, opt):
+        """MXTPU_BF16_STATS=opt|all: optimizer-state storage cast —
+        identity when the knob is off."""
+        if not self.bf16_opt:
+            return opt
+        return jax.tree_util.tree_map(
+            lambda v: (v.astype(jnp.bfloat16)
+                       if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                       else v), opt)
+
     def _init_opt_state(self, params):
-        return {n: self._opt.create_fused_state(v)
-                for n, v in params.items()
-                if n not in self.frozen_param_names}
+        return self.cast_opt_state(
+            {n: self._opt.create_fused_state(v)
+             for n, v in params.items()
+             if n not in self.frozen_param_names})
 
     # ------------------------------------------------------------------
     def _param_spec(self, name, shape=None):
@@ -457,6 +489,7 @@ class TrainStep(object):
         wd_mult = {n: optzr.wd_mult.get(n, 1.0) for n in updated}
         wd = optzr.wd
         clip_norm = getattr(optzr, "clip_global_norm", None)
+        bf16_opt = self.bf16_opt
 
         def step_fn(state, batch, key, lr_base, poison=None):
             params, aux, opt = state["params"], state["aux"], state["opt"]
@@ -522,6 +555,13 @@ class TrainStep(object):
                 new_w, new_s = optzr.fused_update(
                     n, w, g, opt[n], lr_base * lr_mult[n], wd * wd_mult[n],
                     t, key=subkey)
+                if bf16_opt:
+                    # bf16 optimizer state: the update computes in the
+                    # promoted dtype, storage goes back to bf16 — BEFORE
+                    # the guard select (the scan carry dtype must not
+                    # change step-to-step)
+                    new_s = jax.tree_util.tree_map(
+                        lambda a, b: a.astype(b.dtype), new_s, opt[n])
                 if guard:
                     new_w = jnp.where(ok, new_w, w)
                     new_s = jax.tree_util.tree_map(

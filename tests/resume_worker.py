@@ -27,6 +27,40 @@ def _mlp():
     return sym.SoftmaxOutput(data=net, name="softmax")
 
 
+def _convnet(num_classes=4):
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, num_filter=8, kernel=(3, 3),
+                          stride=(2, 2), pad=(1, 1), name="c1")
+    net = sym.BatchNorm(data=net, fix_gamma=False, name="bn1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Pooling(data=net, global_pool=True, kernel=(1, 1),
+                      pool_type="avg")
+    net = sym.Flatten(data=net)
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _make_train_iter():
+    """NDArrayIter by default; RESUME_WORKER_IMAGE_REC=<path.rec> switches
+    to the device-fed input tier — ImageRecordIter through the decode
+    worker pool (RESUME_WORKER_DATA_WORKERS, default 2) with deterministic
+    shuffle — so the SIGKILL test covers resume fast-forward THROUGH the
+    worker-parallel pipeline (docs/perf.md "Device-fed input pipeline")."""
+    rec = os.environ.get("RESUME_WORKER_IMAGE_REC")
+    if rec:
+        nw = int(os.environ.get("RESUME_WORKER_DATA_WORKERS", "2") or 2)
+        train = mx.image.ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 24, 24), batch_size=16,
+            shuffle=True, seed=5, rand_crop=True, rand_mirror=True,
+            resize=28, num_workers=nw)
+        return train, _convnet()
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(256, 10)).astype(np.float32)
+    w = rng.normal(size=(10, 4)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=16), _mlp()  # 16 batches/epoch
+
+
 def main(prefix, out_npz, k):
     # async-checkpoint kill test support: the parent arms a delay on the
     # writer thread (via env, so the SIGKILL lands mid-async-save while
@@ -47,17 +81,13 @@ def main(prefix, out_npz, k):
         mgr = CheckpointManager(prefix, keep=3)
         ckpt_arg = mgr
     mx.random.seed(7)
-    rng = np.random.default_rng(3)
-    X = rng.normal(size=(256, 10)).astype(np.float32)
-    w = rng.normal(size=(10, 4)).astype(np.float32)
-    y = np.argmax(X @ w, axis=1).astype(np.float32)
-    train = mx.io.NDArrayIter(X, y, batch_size=16)  # 16 batches/epoch
+    train, net = _make_train_iter()
     # RESUME_WORKER_CONTEXTS=N: train data-parallel over N devices (the
     # 8-device bitwise kill-and-resume test — docs/perf.md "Data-parallel
     # scaling"); the conftest-style XLA_FLAGS env is the parent's job
     nctx = int(os.environ.get("RESUME_WORKER_CONTEXTS", "1") or 1)
     ctx = [mx.cpu(i) for i in range(nctx)] if nctx > 1 else mx.cpu()
-    mod = mx.mod.Module(_mlp(), context=ctx)
+    mod = mx.mod.Module(net, context=ctx)
 
     def cb(param):
         print("BATCH %d.%d" % (param.epoch, param.nbatch), flush=True)

@@ -275,3 +275,115 @@ def test_superbatch_accepts_legacy_tuple_descs():
     sbs = list(it)
     assert [sb.num_steps for sb in sbs] == [2, 2]
     np.testing.assert_array_equal(sbs[0].data[0].asnumpy()[:, 0, 0], [1, 2])
+
+
+# -- MXIndexedRecordIO tell/seek consistency (the sharded reader depends
+#    on exact offsets — docs/perf.md "Device-fed input pipeline") ----------
+
+def test_recordio_write_tell_interleaving_exact_offsets(tmp_path):
+    """write/tell interleaving: tell() flushes in write mode, so a reader
+    opened MID-WRITE sees exact, durable offsets for every record already
+    indexed."""
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "w.rec")
+    idx = str(tmp_path / "w.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    offsets = []
+    payloads = []
+    for i in range(6):
+        offsets.append(w.tell())
+        payloads.append(b"x" * (7 + 11 * i))  # deliberately unaligned
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), payloads[i]))
+        # mid-write read-back through an independent handle at the
+        # recorded offset: tell()'s flush makes the bytes durable NOW
+        assert w.tell() > offsets[i]
+        rr = recordio.MXRecordIO(path, "r")
+        rr.handle.seek(offsets[i])
+        h, p = recordio.unpack(rr.read())
+        assert (h.label, p) == (float(i), payloads[i])
+        rr.close()
+    assert offsets == sorted(set(offsets)), "offsets must be increasing"
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert [r.idx[k] for k in r.keys] == offsets
+
+
+def test_recordio_read_idx_interleaved_with_sequential_read(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "s.rec")
+    idx = str(tmp_path / "s.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(8):
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), b"p%d" % i))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    # random-access seeks in arbitrary order...
+    for key in (5, 0, 7, 2, 2, 6):
+        h, p = recordio.unpack(r.read_idx(key))
+        assert (h.label, p) == (float(key), b"p%d" % key)
+    # ...and sequential read() continues from AFTER the last read_idx
+    # (the handle lands on the next record boundary, never mid-record)
+    h, p = recordio.unpack(r.read())
+    assert (h.label, p) == (7.0, b"p7")
+    r.seek(3)
+    assert r.tell() == r.idx[3]
+    h, p = recordio.unpack(r.read())
+    assert h.label == 3.0
+
+
+def test_recordio_partial_read_restores_position(tmp_path):
+    """A failed read (truncated record) must leave the handle at the
+    record START: tell() stays meaningful, a later read_idx of a good key
+    works, and re-reading the bad offset fails identically instead of
+    parsing garbage."""
+    import pytest
+    from mxnet_tpu import recordio
+    from mxnet_tpu.base import MXNetError
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(4):
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), b"y" * 64))
+    w.close()
+    # tear the LAST record's payload
+    import os
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 30)
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    with pytest.raises(MXNetError, match="truncated"):
+        r.read_idx(3)
+    assert r.tell() == r.idx[3], "position must restore to record start"
+    # earlier keys still read exactly after the failure...
+    h, p = recordio.unpack(r.read_idx(1))
+    assert (h.label, p) == (1.0, b"y" * 64)
+    # ...and the bad record fails the SAME way again (no garbage parse)
+    with pytest.raises(MXNetError, match="truncated"):
+        r.read_idx(3)
+    with pytest.raises(MXNetError, match="truncated"):
+        r.read_idx(3)
+
+
+def test_recordio_bad_magic_read_restores_position(tmp_path):
+    import pytest
+    from mxnet_tpu import recordio
+    from mxnet_tpu.base import MXNetError
+    path = str(tmp_path / "m.rec")
+    idx = str(tmp_path / "m.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(3):
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), b"z" * 20))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    off1 = r.idx[1]
+    with open(path, "r+b") as f:  # corrupt record 1's magic
+        f.seek(off1)
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(MXNetError, match="magic"):
+        r.read_idx(1)
+    assert r.tell() == off1
+    h, p = recordio.unpack(r.read_idx(2))  # neighbors unaffected
+    assert h.label == 2.0
