@@ -460,6 +460,23 @@ def serve_main():
     lat_ms = np.asarray(latencies) * 1e3
     findings = tracecheck.unsuppressed(
         tracecheck.check_registered(match=eng.name + "/"))
+    # static memory profile of the bucket set (already compiled — free):
+    # per-bucket peak plus the co-resident footprint the AOT cache retains
+    mem_fields = {}
+    try:
+        from mxnet_tpu import memcheck
+        reports = eng.memory_report()
+        if reports:
+            mem_fields = {
+                "hbm_peak_bytes": max(r.peak_bytes
+                                      for r in reports.values()),
+                "temp_bytes": max(r.temp_bytes for r in reports.values()),
+                "hbm_resident_bytes": memcheck.resident_bytes(
+                    reports.values()),
+            }
+    except Exception as exc:
+        print("WARNING: memcheck analysis failed, no HBM fields emitted: %r"
+              % exc, file=sys.stderr)
     out = {
         "metric": "serve_%s_latency_qps%g" % (name, qps),
         "value": round(float(np.percentile(lat_ms, 99)), 3),
@@ -482,6 +499,7 @@ def serve_main():
         "tracecheck_findings": len(findings),
         "retraces": tracecheck.retrace_count(),
     }
+    out.update(mem_fields)
     print(json.dumps(out))
 
 
@@ -679,24 +697,55 @@ def main():
     # cost model counts once, not trip-count times, so the per-image figure
     # must come from the per-step computation
     flops_per_img = None
+    step_compiled = None  # shared with the memory profile below
+    step_args = None
     try:
         key = jax.random.key(0)
         lr_base = jnp.asarray(0.1, jnp.float32)
         if batch not in step._jit:
             step._jit[batch] = step._build(batch)
-        lowered = step._jit[batch].lower(state, data, key, lr_base)
+        step_args = (state, data, key, lr_base)
+        lowered = step._jit[batch].lower(*step_args)
         try:
             ca = lowered.cost_analysis()
         except Exception:
             ca = None
         if ca is None:  # pre-compile analysis unsupported on this backend
-            ca = lowered.compile().cost_analysis()
+            step_compiled = lowered.compile()
+            ca = step_compiled.cost_analysis()
         if isinstance(ca, list):
             ca = ca[0]
         flops_per_img = float(ca["flops"]) / batch
     except Exception as exc:  # MFU is a headline metric: never drop silently
         print("WARNING: cost analysis failed, no MFU emitted: %r" % exc,
               file=sys.stderr)
+        lowered = None
+
+    # static memory profile of the program that actually ran (docs/
+    # static_analysis.md "Memory lints"): peak HBM + temp bytes ride next
+    # to img/s, so a fusion/remat regression that doubles temps is visible
+    # in the same JSON line that would show the throughput cost. The
+    # single-step mode reuses the cost-analysis lowering (at most ONE
+    # extra compile); the scan mode pays one compile of the scan — the
+    # measured program — since jit exposes no handle to its executable.
+    mem = None
+    try:
+        from mxnet_tpu import memcheck
+        if spd > 1:
+            mem = memcheck.analyze(
+                step._jit_scan[(batch, spd)],
+                (state, sbatch, step._dispatch_key(),
+                 jnp.zeros((spd,), jnp.float32)),
+                donate_argnums=(0,), name="bench-scan")
+        elif lowered is not None:
+            if step_compiled is None:
+                step_compiled = lowered.compile()
+            mem = memcheck.analyze_compiled(
+                step_compiled, "bench-step", args=step_args,
+                donate_argnums=(0,))
+    except Exception as exc:  # the bench number must survive an analyzer bug
+        print("WARNING: memcheck analysis failed, no HBM fields emitted: %r"
+              % exc, file=sys.stderr)
 
     peak, kind = _peak_flops(jax.devices()[0])
     metric = "resnet%d_train_images_per_sec_b%d_%s" % (depth, batch, cdtype)
@@ -716,6 +765,10 @@ def main():
     }
     if spd > 1:
         out["steps_per_dispatch"] = spd
+    if mem is not None:
+        out["hbm_peak_bytes"] = mem.peak_bytes
+        out["temp_bytes"] = mem.temp_bytes
+        out["alias_bytes"] = mem.alias_bytes
     if flops_per_img:
         out["gflop_per_image_xla"] = round(flops_per_img / 1e9, 2)
         out["achieved_tflops"] = round(ips * flops_per_img / 1e12, 1)

@@ -1,0 +1,26 @@
+#!/bin/sh
+# CI gate: static HBM audit of the model zoo's compiled step programs
+# (docs/static_analysis.md "Memory lints"). Compiles every zoo program
+# WITHOUT executing it, runs the memory lints (hbm-budget /
+# donation-waste / temp-blowup / resident-set), and compares each
+# program's peak/temp bytes against the committed MEMCHECK_baseline.json
+# with a tolerance band (MXTPU_MEMCHECK_TOL, default 10%) — any program
+# growing past tolerance fails with the buffer breakdown in the message.
+#
+# Baseline-update workflow (docs/static_analysis.md):
+#   python -m mxnet_tpu.memcheck --zoo --write-baseline MEMCHECK_baseline.json
+# and commit the diff alongside the change that moved the numbers.
+#
+# Usage: ci/memcheck.sh [model,model,...]   (default: the whole zoo,
+# gated against the baseline; an explicit subset skips the baseline)
+set -e
+cd "$(dirname "$0")/.."
+MODELS="$1"
+if [ -n "$MODELS" ]; then
+    set -- --models "$MODELS"
+else
+    set -- --zoo --baseline MEMCHECK_baseline.json
+fi
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    python -m mxnet_tpu.memcheck "$@"
+echo "memcheck PASS"

@@ -17,6 +17,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # static lints over the model zoo's compiled step programs
 # (docs/static_analysis.md; tier-1 keeps a faster 2-model smoke)
 ./ci/tracecheck.sh
+# static HBM audit + baseline regression gate over the same zoo
+# (docs/static_analysis.md "Memory lints"): peak/temp bytes per compiled
+# program vs the committed MEMCHECK_baseline.json, tolerance band
+# MXTPU_MEMCHECK_TOL
+./ci/memcheck.sh
 # serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
 # tracecheck findings on the serving program set (docs/serving.md)
 ./ci/serve.sh

@@ -113,6 +113,29 @@ def dp_devices():
         raise MXNetError("MXTPU_DP_DEVICES must be an integer, got %r" % v)
 
 
+def _mode_from_env(env_name, default):
+    """Shared warn|error|off tri-state parser for the analyzers'
+    runtime-policy env knobs; ``default`` is the meaning of unset/empty."""
+    v = os.environ.get(env_name, "").strip().lower()
+    if v == "":
+        return default
+    if v in ("1", "on", "true", "warn", "warning"):
+        return "warn"
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v in ("error", "raise"):
+        return "error"
+    from .base import MXNetError
+    raise MXNetError("%s must be warn|error|off, got %r" % (env_name, v))
+
+
+def _validate_mode(mode, who):
+    if mode is not None and mode not in ("warn", "error", "off"):
+        from .base import MXNetError
+        raise MXNetError("%s: mode must be warn|error|off or None, got %r"
+                         % (who, mode))
+
+
 _tracecheck_override = None
 
 
@@ -124,15 +147,7 @@ def tracecheck_mode():
     signature capture. Env default: ``MXTPU_TRACECHECK``."""
     if _tracecheck_override is not None:
         return _tracecheck_override
-    v = os.environ.get("MXTPU_TRACECHECK", "").strip().lower()
-    if v in ("", "1", "on", "true", "warn", "warning"):
-        return "warn"
-    if v in ("0", "off", "false", "no"):
-        return "off"
-    if v in ("error", "raise"):
-        return "error"
-    from .base import MXNetError
-    raise MXNetError("MXTPU_TRACECHECK must be warn|error|off, got %r" % v)
+    return _mode_from_env("MXTPU_TRACECHECK", "warn")
 
 
 def set_tracecheck(mode):
@@ -140,11 +155,35 @@ def set_tracecheck(mode):
     returns the previous effective value."""
     global _tracecheck_override
     prev = tracecheck_mode()
-    if mode is not None and mode not in ("warn", "error", "off"):
-        from .base import MXNetError
-        raise MXNetError("set_tracecheck: mode must be warn|error|off or "
-                         "None, got %r" % (mode,))
+    _validate_mode(mode, "set_tracecheck")
     _tracecheck_override = mode
+    return prev
+
+
+_memcheck_override = None
+
+
+def memcheck_mode():
+    """Memory-audit policy for load-time-compiled program sets
+    (docs/static_analysis.md "Memory lints"): ``"off"`` (default) skips
+    the audit, ``"warn"`` logs unsuppressed memory findings when a
+    serving tier compiles its program set (``ServingEngine`` buckets,
+    ``DecodeLoop`` body), ``"error"`` raises
+    :class:`~mxnet_tpu.base.MXNetError` — a deploy that cannot fit its
+    budget fails at LOAD, not at the first full-batch request. Env
+    default: ``MXTPU_MEMCHECK``."""
+    if _memcheck_override is not None:
+        return _memcheck_override
+    return _mode_from_env("MXTPU_MEMCHECK", "off")
+
+
+def set_memcheck(mode):
+    """Override the memcheck mode (None = back to the env/default);
+    returns the previous effective value."""
+    global _memcheck_override
+    prev = memcheck_mode()
+    _validate_mode(mode, "set_memcheck")
+    _memcheck_override = mode
     return prev
 
 

@@ -216,6 +216,11 @@ class DecodeLoop(object):
             "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
                                           self.max_len),
             jfn, structs, donate_argnums=(0,))
+        # MXTPU_MEMCHECK: audit the decode body's memory at LOAD time —
+        # the KV cache is the dominant buffer and scales with
+        # slots*max_len, so a misconfigured loop fails here, not mid-fleet
+        from .engine import _audit_load_memory
+        _audit_load_memory(self, "DecodeLoop")
 
         self._join_q = queue.Queue()
         self._slots = [None] * self.slots
@@ -363,9 +368,37 @@ class DecodeLoop(object):
         self.health.record_retire()
 
     # ------------------------------------------------------------------
-    def check(self, const_bytes=None):
+    def memory_report(self, top=8):
+        """Static memory profile of the compiled decode body
+        (docs/static_analysis.md "Memory lints"): ``{program_name:
+        MemoryReport}`` from the already-compiled executable — the donated
+        KV cache's alias accounting included. An executable that cannot
+        report memory is skipped with a warning (mirrors
+        ``ServingEngine.memory_report``)."""
+        from .. import memcheck as _mc
+        import jax
+        import logging
+        name = "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
+                                             self.max_len)
+        try:
+            return {name: _mc.analyze_compiled(
+                self._compiled, name, args=self._structs(jax),
+                donate_argnums=(0,), top=top)}
+        except Exception as e:
+            logging.warning(
+                "DecodeLoop: compiled decode body cannot report memory "
+                "(%s) — skipped from the memory audit", e)
+            return {}
+
+    def check(self, const_bytes=None, memory=False, budget=None):
         """Static-analyze the registered decode program; returns findings
-        (the CI serving gate asserts none — docs/serving.md)."""
+        (the CI serving gate asserts none — docs/serving.md).
+        ``memory=True`` adds the memory lints over the compiled body."""
         from .. import tracecheck as _tc
-        return _tc.check_registered(const_bytes=const_bytes,
-                                    match=self.name + "/")
+        findings = _tc.check_registered(const_bytes=const_bytes,
+                                        match=self.name + "/")
+        if memory:
+            from .. import memcheck as _mc
+            for rep in self.memory_report().values():
+                findings += _mc.lint_report(rep, budget=budget)
+        return findings

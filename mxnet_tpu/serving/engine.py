@@ -52,6 +52,48 @@ def default_buckets():
     return buckets
 
 
+def _audit_load_memory(obj, who):
+    """MXTPU_MEMCHECK load-time hook shared by :class:`ServingEngine` and
+    :class:`~mxnet_tpu.serving.decode.DecodeLoop`: run the memory lints
+    over the freshly compiled program set (``obj.memory_report()``) and
+    warn — or raise, under ``error`` — on any unsuppressed finding."""
+    from ..engine import memcheck_mode
+    mode = memcheck_mode()
+    if mode == "off":
+        return
+    from .. import memcheck as _mc
+    # resolve the knobs BEFORE the analyzer guard: a malformed
+    # MXTPU_MEMCHECK_BUDGET/_TEMP_MULT is an operator error that must
+    # propagate, not silently disable the gate the operator just armed
+    budget = _mc.budget_bytes()
+    temp_mult = _mc.temp_multiple()
+    try:
+        reports = obj.memory_report()
+        findings = []
+        for rep in reports.values():
+            findings += _mc.lint_report(rep, budget=budget,
+                                        temp_mult=temp_mult)
+        findings += _mc.lint_resident_set(
+            reports.values(), "%s/resident-set" % obj.name, budget=budget)
+        bad = _mc.unsuppressed(findings)
+    except Exception as e:
+        # an analyzer failure (a backend whose executables cannot report
+        # memory, an HLO format drift) must never abort the deploy the
+        # audit exists to protect — log and skip; only FINDINGS raise
+        logging.warning("%s(%s): memory audit could not run (%r) — "
+                        "skipped", who, obj.name, e)
+        return
+    if not bad:
+        return
+    msg = ("%s(%s): memory audit found %d problem(s) at load "
+           "(MXTPU_MEMCHECK=%s):\n%s"
+           % (who, obj.name, len(bad), mode,
+              "\n".join(f.format() for f in bad)))
+    if mode == "error":
+        raise MXNetError(msg)
+    logging.warning(msg)
+
+
 class ServingEngine(object):
     """AOT-compiled, shape-bucketed forward over a saved checkpoint.
 
@@ -165,6 +207,11 @@ class ServingEngine(object):
             self._out_row_factor.append(
                 lead // self.buckets[0]
                 if lead and lead % self.buckets[0] == 0 else None)
+        # MXTPU_MEMCHECK: audit the freshly compiled bucket set's memory
+        # at LOAD time (docs/static_analysis.md "Memory lints") — a deploy
+        # that cannot fit its budget fails here, not at the first
+        # full-batch request
+        _audit_load_memory(self, "ServingEngine")
 
     # ------------------------------------------------------------------
     def _full_shapes(self, b):
@@ -291,9 +338,44 @@ class ServingEngine(object):
             return False
 
     # ------------------------------------------------------------------
-    def check(self, const_bytes=None):
+    def memory_report(self, top=8):
+        """Static memory profile of every compiled bucket
+        (docs/static_analysis.md "Memory lints"): returns ``{bucket:
+        MemoryReport}`` from the ALREADY-compiled executables — no
+        recompile, nothing executes. Buckets imported from a serialized
+        executable file that cannot report memory are skipped with a
+        warning."""
+        from .. import memcheck as _mc
+        reports = {}
+        for b, comp in sorted(self._compiled.items()):
+            try:
+                reports[b] = _mc.analyze_compiled(
+                    comp, "%s/bucket[b=%d]" % (self.name, b),
+                    args=self._bucket_structs(b), top=top)
+            except Exception as e:
+                logging.warning(
+                    "ServingEngine: bucket %d executable cannot report "
+                    "memory (%s) — skipped from the memory audit", b, e)
+        return reports
+
+    def check(self, const_bytes=None, memory=False, budget=None):
         """Static-analyze this engine's registered bucket programs
-        (docs/static_analysis.md); returns the findings."""
+        (docs/static_analysis.md); returns the findings.
+
+        ``memory=True`` additionally runs the memory lints over every
+        compiled bucket (``hbm-budget``/``temp-blowup``) plus the
+        ``resident-set`` lint over the whole bucket set — the jit/AOT
+        cache keeps every bucket's executable reachable, so their
+        footprints co-reside."""
         from .. import tracecheck as _tc
-        return _tc.check_registered(const_bytes=const_bytes,
-                                    match=self.name + "/")
+        findings = _tc.check_registered(const_bytes=const_bytes,
+                                        match=self.name + "/")
+        if memory:
+            from .. import memcheck as _mc
+            reports = self.memory_report()
+            for rep in reports.values():
+                findings += _mc.lint_report(rep, budget=budget)
+            findings += _mc.lint_resident_set(
+                reports.values(), "%s/resident-set" % self.name,
+                budget=budget)
+        return findings

@@ -185,6 +185,17 @@ class Symbol(object):
     def __deepcopy__(self, memo):
         return load_json(self.tojson())
 
+    # pickle via the JSON graph form: the default protocol would walk the
+    # recursive _Node.inputs chain and blow the recursion limit on deep
+    # nets (resnet), while tojson/load_json serialize node-per-node over a
+    # topological order (this also keeps KVStore.set_optimizer — which
+    # pickles the Optimizer holding the Symbol — working for every model)
+    def __getstate__(self):
+        return {"json": self.tojson()}
+
+    def __setstate__(self, state):
+        self._outputs = load_json(state["json"])._outputs
+
     # -- arithmetic composition ----------------------------------------
     def _binary(self, opname, other, reverse=False):
         if isinstance(other, Symbol):

@@ -56,6 +56,12 @@ lint id                   fires when
                           GSPMD inserted
 ========================  ==================================================
 
+The memory-side lints (``hbm-budget``, ``donation-waste``,
+``temp-blowup``, ``resident-set``) live in :mod:`mxnet_tpu.memcheck` —
+the HBM analyzer that COMPILES programs and audits their buffer
+assignment — but share this module's :class:`Finding` framework and
+suppression registry (docs/static_analysis.md "Memory lints").
+
 Suppression: put ``# tracecheck: ignore[lint-id]`` (or a bare
 ``# tracecheck: ignore`` for all lints) on — or on the line above — the
 source line a finding's provenance points at; or register a programmatic
@@ -91,6 +97,11 @@ from .base import MXNetError
 
 LINTS = ("host-sync", "retrace", "donation", "const-capture", "dtype-f64",
          "dtype-weak", "collective-in-scan")
+
+#: memory lints (implemented in :mod:`mxnet_tpu.memcheck` — the HBM-side
+#: complement of this analyzer; docs/static_analysis.md "Memory lints").
+#: Declared here so one suppression registry covers both analyzers.
+MEM_LINTS = ("hbm-budget", "donation-waste", "temp-blowup", "resident-set")
 
 #: gather-type collective primitives that must NOT appear inside a scan
 #: body (jaxpr level — explicit shard_map collectives). ``psum`` is the
@@ -198,9 +209,9 @@ def add_suppression(lint, program=None):
     """Suppress ``lint`` findings globally, or only for programs whose name
     contains ``program``. Returns a token usable with
     :func:`remove_suppression`."""
-    if lint not in LINTS and lint != "*":
+    if lint not in LINTS + MEM_LINTS and lint != "*":
         raise MXNetError("tracecheck: unknown lint %r (have %s)"
-                         % (lint, ", ".join(LINTS)))
+                         % (lint, ", ".join(LINTS + MEM_LINTS)))
     tok = (lint, program)
     _SUPPRESSIONS.add(tok)
     return tok
@@ -664,21 +675,89 @@ def _lint_dtype(closed, args, kwargs, name):
     return findings
 
 
-def _lint_consts(closed, const_bytes, name):
+def _const_sources(fn):
+    """Python-level ``{name: value}`` candidates for a program's captured
+    constants: the (unwrapped) traced function's closure cells plus the
+    globals its code references."""
+    import inspect
+    try:
+        f = inspect.unwrap(getattr(fn, "__wrapped__", fn))
+    except Exception:
+        f = fn
+    code = getattr(f, "__code__", None)
+    if code is None:
+        return {}
+    out = {}
+    for nm, cell in zip(code.co_freevars, getattr(f, "__closure__", ())
+                        or ()):
+        try:
+            out[nm] = cell.cell_contents
+        except ValueError:
+            pass
+    g = getattr(f, "__globals__", None) or {}
+    for nm in code.co_names:
+        if nm in g:
+            out.setdefault(nm, g[nm])
+    return out
+
+
+def _const_var_name(c, sources):
+    """Best-effort name of the closure variable a captured constant came
+    from: object identity first, else a UNIQUE shape+dtype match (an
+    ambiguous match names nothing rather than the wrong variable)."""
+    ids = [nm for nm, v in sources.items() if v is c]
+    if len(ids) == 1:
+        return ids[0]
+    shape = tuple(getattr(c, "shape", ()) or ())
+    dt = str(getattr(c, "dtype", ""))
+    matches = [nm for nm, v in sources.items()
+               if hasattr(v, "shape") and hasattr(v, "dtype")
+               and tuple(getattr(v, "shape", ()) or ()) == shape
+               and str(getattr(v, "dtype", "")) == dt]
+    if len(matches) == 1:
+        return matches[0]
+    return None
+
+
+def _const_first_uses(closed):
+    """``const index -> (op_path, provenance)`` of the first equation
+    consuming each captured constant."""
+    uses = {}
+    cids = {id(v): i for i, v in enumerate(closed.jaxpr.constvars)}
+    if not cids:
+        return uses
+    for eqn, path in walk_jaxpr(closed.jaxpr):
+        for v in eqn.invars:
+            i = cids.get(id(v))
+            if i is not None and i not in uses:
+                uses[i] = (path, _provenance(eqn))
+        if len(uses) == len(cids):
+            break
+    return uses
+
+
+def _lint_consts(closed, const_bytes, name, fn=None):
     threshold = (_const_bytes_default() if const_bytes is None
                  else int(const_bytes))
     findings = []
+    sources = _const_sources(fn) if fn is not None else {}
+    first_uses = None
     for i, c in enumerate(closed.consts):
         nbytes = getattr(c, "nbytes", 0) or 0
         if nbytes > threshold:
+            if first_uses is None:
+                first_uses = _const_first_uses(closed)
+            varname = _const_var_name(c, sources)
+            _, prov = first_uses.get(i, (None, None))
             findings.append(Finding(
                 "const-capture", name,
-                "closure-captured constant consts[%d] %s%s is %d bytes "
-                "(> %d, MXTPU_TRACECHECK_CONST_BYTES) baked into the "
-                "program — pass it as an argument instead"
-                % (i, getattr(c, "dtype", "?"),
+                "closure-captured constant %s (consts[%d], %s%s) is %d "
+                "bytes (> %d, MXTPU_TRACECHECK_CONST_BYTES) baked into "
+                "the program — pass it as an argument instead"
+                % ("variable %r" % varname if varname else "consts[%d]" % i,
+                   i, getattr(c, "dtype", "?"),
                    list(getattr(c, "shape", ())), nbytes, threshold),
-                op_path="consts[%d]" % i))
+                op_path="consts[%d]" % i, provenance=prov))
     return findings
 
 
@@ -845,7 +924,7 @@ def check_program(fn, args=(), kwargs=None, donate_argnums=(), name=None,
     findings = []
     findings += _lint_host_sync(closed, hlo_text, name)
     findings += _lint_dtype(closed, args, kwargs, name)
-    findings += _lint_consts(closed, const_bytes, name)
+    findings += _lint_consts(closed, const_bytes, name, fn=jitted)
     findings += _lint_collectives(closed, name)
     findings += _lint_donation(closed, hlo_text, wlog, donate_argnums,
                                args, kwargs, name)
@@ -858,16 +937,20 @@ def check_program(fn, args=(), kwargs=None, donate_argnums=(), name=None,
 # TrainStep auditing + the model-zoo CLI
 # ---------------------------------------------------------------------------
 
-def check_train_step(ts, data_shapes, label_shapes, k=2, guard=True,
-                     const_bytes=None, name=None):
-    """Audit a :class:`~mxnet_tpu.train_step.TrainStep`'s full program set
-    — unguarded step, guarded step, K-step scan, guarded K-step scan — over
-    the given ``{name: shape}`` dicts. No step program ever executes; the
-    state skeleton is built with a no-op initializer (zero-filled buffers,
-    never trained — param-drawing RNG and its host cost are skipped) purely
-    to capture the state pytree's shapes/dtypes."""
+def train_step_programs(ts, data_shapes, label_shapes, k=2, guard=True,
+                        name=None):
+    """The ``(name, jitfn, example_args)`` program set of one
+    :class:`~mxnet_tpu.train_step.TrainStep` — unguarded step, K-step
+    scan, and (with ``guard``) their guarded variants — over the given
+    ``{name: shape}`` dicts. This is THE recipe for what training
+    dispatches (argument order, donated state at argnum 0, the traced
+    lr/poison extras), shared by :func:`check_train_step` and
+    ``memcheck.check_train_step`` so the two analyzers can never drift
+    apart on program shape. No step program ever executes; the state
+    skeleton is built with a no-op initializer (zero-filled buffers,
+    never trained — param-drawing RNG and its host cost are skipped)
+    purely to capture the state pytree's shapes/dtypes."""
     import jax
-    import jax.numpy as jnp
     name = name or "TrainStep(%s)" % ts.symbol.name
     state = ts.init(data_shapes, label_shapes,
                     initializer=lambda desc, arr: None, seed=0)
@@ -900,8 +983,18 @@ def check_train_step(ts, data_shapes, label_shapes, k=2, guard=True,
              ts._build_scan(bs, k, guard=True),
              (state_s, sb, key, lrs, poisons)),
         ]
+    return programs
+
+
+def check_train_step(ts, data_shapes, label_shapes, k=2, guard=True,
+                     const_bytes=None, name=None):
+    """Audit a :class:`~mxnet_tpu.train_step.TrainStep`'s full program set
+    — unguarded step, guarded step, K-step scan, guarded K-step scan —
+    over the given ``{name: shape}`` dicts (see
+    :func:`train_step_programs` for how the set is built)."""
     findings = []
-    for pname, jitfn, pargs in programs:
+    for pname, jitfn, pargs in train_step_programs(
+            ts, data_shapes, label_shapes, k=k, guard=guard, name=name):
         findings += check_program(jitfn, pargs, donate_argnums=(0,),
                                   name=pname, const_bytes=const_bytes)
     return findings
@@ -954,14 +1047,12 @@ def check_zoo(names=None, k=2, guard=True, const_bytes=None, log=None):
     return findings, nprog
 
 
-def report(findings, out=None, as_json=False):
+def report(findings, out=None):
+    """Write one formatted line per finding (the CLIs' human-readable
+    mode; their ``--json`` paths serialize a structured object
+    themselves)."""
     import sys
     out = out or sys.stdout
-    if as_json:
-        import json as _json
-        out.write(_json.dumps([f.as_dict() for f in findings], indent=2)
-                  + "\n")
-        return
     for f in findings:
         out.write(f.format() + "\n")
 
@@ -1005,9 +1096,17 @@ def main(argv=None):
     findings, nprog = check_zoo(names=names, k=args.k,
                                 guard=not args.no_guard,
                                 const_bytes=args.const_bytes, log=log)
-    report(findings, as_json=args.json)
     bad = unsuppressed(findings)
-    if not args.json:
+    if args.json:
+        import json as _json
+        print(_json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "total": len(findings),
+            "suppressed": len(findings) - len(bad),
+            "programs": nprog,
+        }, indent=2))
+    else:
+        report(findings)
         print("tracecheck: %d finding(s) (%d suppressed) over %d program(s)"
               % (len(findings), len(findings) - len(bad), nprog))
     return 1 if bad else 0

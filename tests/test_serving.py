@@ -450,3 +450,128 @@ def test_serving_health_mirrors_process_global():
     assert after["examples"] == base["examples"] + 3
     assert after["padded"] == base["padded"] + 1
     assert eng.health.report()["batches"] == 1
+
+
+# ---------------------------------------------------------------------------
+# retrace pins (docs/static_analysis.md): the serving tier is AOT — the
+# jit entries behind the compiled executables must NEVER grow a cache
+# ---------------------------------------------------------------------------
+
+def test_engine_bucket_switching_never_retraces():
+    """Alternating request sizes across every bucket — padding, exact fit,
+    chunking past the max — is pure executable reuse: the engine's
+    underlying jit entry must not trace once (a trace here means the AOT
+    path silently fell back to jit dispatch)."""
+    from mxnet_tpu.test_utils import assert_no_retrace
+    eng = _engine(buckets=(2, 4, 8))
+    with assert_no_retrace(eng._jfn):
+        for n in (1, 4, 2, 8, 3, 20, 1, 8):
+            outs = eng.infer({"data": _x(n)})
+            assert outs[0].shape[0] == n
+
+
+def test_decode_join_retire_cycles_never_retrace():
+    """Sequences joining free slots mid-stream, retiring at different
+    lengths, and fresh rounds re-filling the slots all ride ONE compiled
+    decode body — no retrace across the whole churn."""
+    from mxnet_tpu.test_utils import assert_no_retrace
+    params, _eng = _lm_setup()
+    loop = serving.DecodeLoop(params, num_layers=_LM["num_layers"],
+                              num_heads=_LM["num_heads"],
+                              max_len=_LM["seq_len"], slots=2)
+    try:
+        with assert_no_retrace(loop._jfn):
+            for _round in range(2):
+                futs = [loop.generate(p, n)
+                        for p, n in zip([[1, 2], [3], [4, 5, 6]],
+                                        [3, 2, 2])]
+                for f in futs:
+                    f.result(timeout=120)
+        assert loop.health.retired == 6
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# memory audit (docs/static_analysis.md "Memory lints")
+# ---------------------------------------------------------------------------
+
+def test_engine_memory_report_and_check_clean():
+    """Every compiled bucket reports a static memory profile (no
+    recompile, nothing executes) and the default budget audits clean."""
+    eng = _engine(buckets=(2, 4))
+    reps = eng.memory_report()
+    assert sorted(reps) == [2, 4]
+    for rep in reps.values():
+        assert rep.peak_bytes > 0
+        assert rep.argument_bytes > 0
+        assert rep.platform
+    assert [f.format() for f in eng.check(memory=True)] == []
+
+
+def test_engine_memory_budget_findings():
+    """An absurd budget turns every bucket into an hbm-budget finding plus
+    one resident-set finding over the co-resident bucket set."""
+    eng = _engine(buckets=(2, 4))
+    fs = eng.check(memory=True, budget=256)
+    lints = [f.lint for f in fs]
+    assert lints.count("hbm-budget") == 2
+    assert lints.count("resident-set") == 1
+    rs = [f for f in fs if f.lint == "resident-set"][0]
+    assert "bucket[b=2]" in rs.message and "bucket[b=4]" in rs.message
+
+
+def test_engine_load_audit_error_mode():
+    """MXTPU_MEMCHECK=error: a deploy whose bucket set cannot fit the
+    budget fails at LOAD, naming the findings — not at the first
+    full-batch request."""
+    from mxnet_tpu import engine as _engmod
+    prev = _engmod.set_memcheck("error")
+    os.environ["MXTPU_MEMCHECK_BUDGET"] = "256"
+    try:
+        with pytest.raises(MXNetError, match="memory audit"):
+            _engine(buckets=(2,))
+    finally:
+        del os.environ["MXTPU_MEMCHECK_BUDGET"]
+        _engmod.set_memcheck(prev)
+    # warn mode constructs fine and logs instead
+    prev = _engmod.set_memcheck("warn")
+    os.environ["MXTPU_MEMCHECK_BUDGET"] = "256"
+    try:
+        eng = _engine(buckets=(2,))
+        assert eng.infer({"data": _x(2)})[0].shape[0] == 2
+    finally:
+        del os.environ["MXTPU_MEMCHECK_BUDGET"]
+        _engmod.set_memcheck(prev)
+    # a MALFORMED budget is an operator error, not an analyzer failure:
+    # it must propagate even in warn mode rather than silently disarm
+    # the gate the operator just configured
+    prev = _engmod.set_memcheck("warn")
+    os.environ["MXTPU_MEMCHECK_BUDGET"] = "16gigs"
+    try:
+        with pytest.raises(MXNetError, match="MXTPU_MEMCHECK_BUDGET"):
+            _engine(buckets=(2,))
+    finally:
+        del os.environ["MXTPU_MEMCHECK_BUDGET"]
+        _engmod.set_memcheck(prev)
+
+
+def test_decode_memory_report_cache_aliased():
+    """The decode body's dominant buffer is the donated KV cache — the
+    memory report must show it fully aliased (a copy would double serving
+    memory per step) and the memory lints stay clean."""
+    params, _eng = _lm_setup()
+    loop = serving.DecodeLoop(params, num_layers=_LM["num_layers"],
+                              num_heads=_LM["num_heads"],
+                              max_len=_LM["seq_len"], slots=2)
+    try:
+        (name, rep), = loop.memory_report().items()
+        embed = params["tok_embed_weight"].shape[1]
+        head_dim = embed // _LM["num_heads"]
+        cache_bytes = 2 * (_LM["num_layers"] * 2 * _LM["num_heads"]
+                           * _LM["seq_len"] * head_dim) * 4
+        assert rep.alias_bytes >= cache_bytes
+        assert rep.unaliased_donated == []
+        assert [f.format() for f in loop.check(memory=True)] == []
+    finally:
+        loop.close()

@@ -150,6 +150,11 @@ def test_const_capture_lint_oversized_closure():
     assert len(hits) == 1
     assert "1228800 bytes" in hits[0].message
     assert "consts[0]" == hits[0].op_path
+    # the finding names the CAPTURED CLOSURE VARIABLE and its dtype/shape,
+    # and carries the provenance of the constant's first use
+    assert "variable 'big'" in hits[0].message
+    assert "float32[1024, 300]" in hits[0].message
+    assert hits[0].provenance and "test_tracecheck" in hits[0].provenance
     # above the default 1 MiB threshold too; a higher explicit one passes
     assert not [f for f in tc.check_program(
         with_baked_const, (_sds((4,)),), name="seeded-const",
@@ -550,11 +555,35 @@ def test_cli_list_and_bad_model():
 
 
 def test_cli_json_output(capsys):
+    """--json emits an object: the findings list plus the suppressed and
+    program counts (machine-readable gate summary)."""
     import json
     rc = tc.main(["--models", "mlp", "--json"])
     data = json.loads(capsys.readouterr().out)
     assert rc == 0
-    assert isinstance(data, list)
+    assert isinstance(data["findings"], list)
+    assert data["suppressed"] == 0
+    assert data["total"] == len(data["findings"])
+    assert data["programs"] == 4  # step / scan / guarded-step / guarded-scan
+
+
+def test_cli_json_counts_suppressed_findings(capsys, monkeypatch):
+    """A suppressed finding still reports and is COUNTED in the json
+    summary's suppressed field; the unsuppressed one still fails the
+    gate."""
+    import json
+    seeded = [
+        tc.Finding("host-sync", "fake/step", "seeded-suppressed",
+                   suppressed=True),
+        tc.Finding("dtype-weak", "fake/step", "seeded-live"),
+    ]
+    monkeypatch.setattr(tc, "check_zoo", lambda **kw: (list(seeded), 4))
+    rc = tc.main(["--models", "mlp", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["total"] == 2
+    assert data["suppressed"] == 1
+    assert [f["suppressed"] for f in data["findings"]] == [True, False]
 
 
 # ---------------------------------------------------------------------------
