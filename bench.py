@@ -31,7 +31,12 @@ measured twice through the fused K-step scan — single device, and sharded
 over an N-way 'data' mesh at the SAME global batch (params replicated,
 batch axis split, gradient psum inside the donated body) — and the line
 gains ``dp: {n_devices, img_per_sec, img_per_sec_1chip,
-scaling_efficiency}``. Needs N visible devices (on CPU:
+scaling_efficiency, collective_count, collective_bytes,
+predicted_efficiency}`` (the last three from the commscheck static
+inventory + roofline — docs/static_analysis.md "Communication lints";
+the headline line carries the same three fields for the measured
+program, zero collectives / efficiency 1.0 single-device). Needs N
+visible devices (on CPU:
 ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
 
 BENCH_SERVE=1 switches to the serving latency bench (docs/serving.md):
@@ -77,22 +82,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-# peak dense bf16 FLOP/s by TPU generation (public spec sheets)
-_PEAK_BF16 = {
-    "TPU v2": 46e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
 def _peak_flops(device):
+    """Peak dense bf16 FLOP/s by TPU generation — ONE table, owned by
+    commscheck (its roofline and this bench's MFU must agree on the same
+    device). Unknown kinds return None here (MFU is omitted rather than
+    guessed) instead of commscheck's nominal CPU fallback."""
+    from mxnet_tpu.commscheck import PEAK_FLOPS_PER_S
     kind = getattr(device, "device_kind", "")
-    for k, v in _PEAK_BF16.items():
+    for k, v in PEAK_FLOPS_PER_S.items():
         if kind.startswith(k):
             return v, kind
     return None, kind
@@ -545,11 +542,13 @@ def _dp_scaling_row(sym, dshape, batch, sdtype, cdtype, remat, spd, rounds):
     so the efficiency ratio compares like with like; the superbatch is
     device-resident (landed sharded once), so this is pure step scaling,
     not input scaling."""
+    import jax.numpy as jnp
     from mxnet_tpu.train_step import TrainStep
     from mxnet_tpu.parallel.mesh import data_parallel_mesh
 
     n = int(os.environ.get("BENCH_DP_DEVICES"))
     k = max(1, spd)
+    sharded = {}  # the n-device side's program + struct args for commscheck
 
     def measure(mesh):
         step = TrainStep(
@@ -564,6 +563,15 @@ def _dp_scaling_row(sym, dshape, batch, sdtype, cdtype, remat, spd, rounds):
                              * k),
             "softmax_label": np.stack(
                 [rng.integers(0, 1000, batch).astype(np.float32)] * k)})
+        if mesh is not None:
+            # struct capture BEFORE measuring: the scan donates the state
+            # buffers, and the comms analyzer needs only shardings/shapes
+            from mxnet_tpu import commscheck
+            sharded["args"] = commscheck.struct_args(
+                (state, sb, step._dispatch_key(),
+                 jnp.zeros((k,), jnp.float32)))
+            sharded["step"] = step
+            sharded["mesh"] = mesh
         # keep measured *steps* roughly constant as K grows (as main does)
         n_short = max(2, (20 + k - 1) // k)
         n_long = max(n_short + 5, (120 + k - 1) // k)
@@ -572,12 +580,30 @@ def _dp_scaling_row(sym, dshape, batch, sdtype, cdtype, remat, spd, rounds):
 
     ips1 = measure(None)
     ipsn = measure(data_parallel_mesh(n))
-    return {
+    row = {
         "n_devices": n,
         "img_per_sec": round(ipsn, 2),
         "img_per_sec_1chip": round(ips1, 2),
         "scaling_efficiency": (round(ipsn / ips1, 3) if ips1 > 0 else None),
     }
+    # static comms profile of the measured sharded scan (one extra compile;
+    # docs/static_analysis.md "Communication lints"): the roofline's
+    # prediction rides next to the measured efficiency, so the gap between
+    # model and machine is visible in every BENCH_DP_DEVICES line
+    try:
+        from mxnet_tpu import commscheck
+        rep = commscheck.analyze(
+            sharded["step"]._jit_scan[(batch, k)], sharded["args"],
+            name="bench-dp-scan", mesh=sharded["mesh"], loop_trips=k)
+        row["collective_count"] = rep.collective_count
+        row["collective_bytes"] = rep.collective_bytes
+        row["predicted_efficiency"] = (
+            None if rep.predicted_efficiency is None
+            else round(rep.predicted_efficiency, 3))
+    except Exception as exc:
+        print("WARNING: commscheck analysis failed, no dp comms fields "
+              "emitted: %r" % exc, file=sys.stderr)
+    return row
 
 
 def main():
@@ -729,23 +755,44 @@ def main():
     # extra compile); the scan mode pays one compile of the scan — the
     # measured program — since jit exposes no handle to its executable.
     mem = None
+    comms = None
+    measured_compiled = None  # ONE compile shared by both analyzers
     try:
         from mxnet_tpu import memcheck
         if spd > 1:
-            mem = memcheck.analyze(
-                step._jit_scan[(batch, spd)],
-                (state, sbatch, step._dispatch_key(),
-                 jnp.zeros((spd,), jnp.float32)),
-                donate_argnums=(0,), name="bench-scan")
+            scan_args = (state, sbatch, step._dispatch_key(),
+                         jnp.zeros((spd,), jnp.float32))
+            measured_compiled = step._jit_scan[(batch, spd)] \
+                .lower(*scan_args).compile()
+            mem = memcheck.analyze_compiled(
+                measured_compiled, "bench-scan", args=scan_args,
+                donate_argnums=(0,))
         elif lowered is not None:
             if step_compiled is None:
                 step_compiled = lowered.compile()
+            measured_compiled = step_compiled
             mem = memcheck.analyze_compiled(
                 step_compiled, "bench-step", args=step_args,
                 donate_argnums=(0,))
     except Exception as exc:  # the bench number must survive an analyzer bug
         print("WARNING: memcheck analysis failed, no HBM fields emitted: %r"
               % exc, file=sys.stderr)
+    # static comms profile of the same executable (docs/static_analysis.md
+    # "Communication lints"): collective count/bytes + the roofline's
+    # predicted scaling efficiency ride next to img/s and hbm_peak_bytes —
+    # zero collectives and efficiency 1.0 on a single-device run, so a
+    # sharding change that makes the headline program communicate shows in
+    # the same JSON line as its throughput cost
+    try:
+        from mxnet_tpu import commscheck
+        if measured_compiled is not None:
+            comms = commscheck.analyze_compiled(
+                measured_compiled,
+                "bench-scan" if spd > 1 else "bench-step",
+                mesh=step.mesh, loop_trips=max(1, spd))
+    except Exception as exc:
+        print("WARNING: commscheck analysis failed, no comms fields "
+              "emitted: %r" % exc, file=sys.stderr)
 
     peak, kind = _peak_flops(jax.devices()[0])
     metric = "resnet%d_train_images_per_sec_b%d_%s" % (depth, batch, cdtype)
@@ -769,6 +816,12 @@ def main():
         out["hbm_peak_bytes"] = mem.peak_bytes
         out["temp_bytes"] = mem.temp_bytes
         out["alias_bytes"] = mem.alias_bytes
+    if comms is not None:
+        out["collective_count"] = comms.collective_count
+        out["collective_bytes"] = comms.collective_bytes
+        out["predicted_efficiency"] = (
+            None if comms.predicted_efficiency is None
+            else round(comms.predicted_efficiency, 3))
     if flops_per_img:
         out["gflop_per_image_xla"] = round(flops_per_img / 1e9, 2)
         out["achieved_tflops"] = round(ips * flops_per_img / 1e12, 1)

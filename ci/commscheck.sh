@@ -1,0 +1,36 @@
+#!/bin/sh
+# CI gate: static collective-communication audit of the zoo's 28 compiled
+# step programs PLUS the PR 7 sharded gate set (dp lenet scan, dp x tp
+# resnet18, dp x sp ring transformer) — docs/static_analysis.md
+# "Communication lints". Compiles every program WITHOUT executing it,
+# runs the comms lints (resharding-copy / replicated-large /
+# gather-in-loop / comms-bound), and compares each program's per-dispatch
+# collective count and payload bytes against the committed
+# COMMSCHECK_baseline.json with a tolerance band (MXTPU_COMMSCHECK_TOL,
+# default 10%; counts are HLO-deterministic, so there is no absolute
+# slack and a collective appearing where the baseline pinned zero fails
+# at any tolerance) — a refactor that sneaks an all-gather into the scan
+# body or triples the psum payload fails HERE, with byte count and
+# source provenance, before any multichip run.
+#
+# Baseline-update workflow (docs/static_analysis.md):
+#   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+#     python -m mxnet_tpu.commscheck --zoo --sharded \
+#     --write-baseline COMMSCHECK_baseline.json
+# and commit the diff alongside the change that moved the numbers.
+#
+# Usage: ci/commscheck.sh [model,model,...]   (default: zoo + sharded
+# set, gated against the baseline; an explicit subset skips both the
+# sharded set and the baseline)
+set -e
+cd "$(dirname "$0")/.."
+MODELS="$1"
+if [ -n "$MODELS" ]; then
+    set -- --models "$MODELS"
+else
+    set -- --zoo --sharded --baseline COMMSCHECK_baseline.json
+fi
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+    PYTHONPATH=. python -m mxnet_tpu.commscheck "$@"
+echo "commscheck PASS"

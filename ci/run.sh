@@ -22,6 +22,12 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # program vs the committed MEMCHECK_baseline.json, tolerance band
 # MXTPU_MEMCHECK_TOL
 ./ci/memcheck.sh
+# static collective-communication audit + drift gate (docs/
+# static_analysis.md "Communication lints"): collective inventory +
+# comms lints over the zoo AND the PR 7 sharded set (dp lenet scan,
+# dp x tp resnet18, dp x sp ring transformer), per-dispatch collective
+# count/bytes vs the committed COMMSCHECK_baseline.json
+./ci/commscheck.sh
 # serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
 # tracecheck findings on the serving program set (docs/serving.md)
 ./ci/serve.sh

@@ -187,6 +187,35 @@ def set_memcheck(mode):
     return prev
 
 
+_commscheck_override = None
+
+
+def commscheck_mode():
+    """Collective-communication audit policy for SHARDED dispatch
+    programs (docs/static_analysis.md "Communication lints"): ``"off"``
+    (default) skips the audit — the CLI/CI drift gate covers the
+    committed program sets; ``"warn"`` makes a mesh-bearing
+    ``TrainStep`` run the comms lints ONCE per compiled program at its
+    first dispatch (one extra compile, arguments carry the real
+    shardings) and log unsuppressed findings; ``"error"`` raises
+    :class:`~mxnet_tpu.base.MXNetError` — a sharding mistake that
+    gathers inside the scan body fails at the first dispatch, not after
+    a slow multichip run. Env default: ``MXTPU_COMMSCHECK``."""
+    if _commscheck_override is not None:
+        return _commscheck_override
+    return _mode_from_env("MXTPU_COMMSCHECK", "off")
+
+
+def set_commscheck(mode):
+    """Override the commscheck mode (None = back to the env/default);
+    returns the previous effective value."""
+    global _commscheck_override
+    prev = commscheck_mode()
+    _validate_mode(mode, "set_commscheck")
+    _commscheck_override = mode
+    return prev
+
+
 def maybe_sync(arr):
     """Called after each imperative op; blocks in naive mode."""
     if _naive and arr is not None:

@@ -59,8 +59,12 @@ lint id                   fires when
 The memory-side lints (``hbm-budget``, ``donation-waste``,
 ``temp-blowup``, ``resident-set``) live in :mod:`mxnet_tpu.memcheck` —
 the HBM analyzer that COMPILES programs and audits their buffer
-assignment — but share this module's :class:`Finding` framework and
-suppression registry (docs/static_analysis.md "Memory lints").
+assignment — and the communication-side lints (``resharding-copy``,
+``replicated-large``, ``gather-in-loop``, ``comms-bound``) in
+:mod:`mxnet_tpu.commscheck`, the collective-inventory analyzer whose
+parser also backs :func:`check_collectives`; both share this module's
+:class:`Finding` framework and suppression registry
+(docs/static_analysis.md "Memory lints" / "Communication lints").
 
 Suppression: put ``# tracecheck: ignore[lint-id]`` (or a bare
 ``# tracecheck: ignore`` for all lints) on — or on the line above — the
@@ -103,6 +107,13 @@ LINTS = ("host-sync", "retrace", "donation", "const-capture", "dtype-f64",
 #: Declared here so one suppression registry covers both analyzers.
 MEM_LINTS = ("hbm-budget", "donation-waste", "temp-blowup", "resident-set")
 
+#: communication lints (implemented in :mod:`mxnet_tpu.commscheck` — the
+#: collective-traffic side of the analyzer trilogy; docs/static_analysis.md
+#: "Communication lints"). Declared here so ONE suppression registry
+#: covers all three analyzers.
+COMM_LINTS = ("resharding-copy", "replicated-large", "gather-in-loop",
+              "comms-bound")
+
 #: gather-type collective primitives that must NOT appear inside a scan
 #: body (jaxpr level — explicit shard_map collectives). ``psum`` is the
 #: expected grad/metric sync and ``ppermute`` the ring/pipeline schedule
@@ -111,16 +122,6 @@ _SCAN_COLLECTIVE_PRIMS = frozenset({
     "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
     "pgather",
 })
-
-#: compiled-HLO collective opcodes; ``all-reduce`` is the expected
-#: grad/metric psum, everything else inside a while body is a finding
-_HLO_COLLECTIVE_KINDS = ("all-gather", "all-to-all", "reduce-scatter",
-                         "collective-permute", "all-reduce")
-_HLO_COLLECTIVE_RE = re.compile(
-    r"=\s+\S+\s+(%s)(?:-start)?\("
-    % "|".join(re.escape(kind) for kind in _HLO_COLLECTIVE_KINDS))
-_HLO_OPNAME_RE = re.compile(r'op_name="([^"]+)"')
-_HLO_SOURCE_RE = re.compile(r'source_file="([^"]+)"\s+source_line=(\d+)')
 
 #: callback-ish primitives whose presence inside a compiled step program
 #: means a host round-trip on every execution (the scan body runs them K
@@ -209,9 +210,10 @@ def add_suppression(lint, program=None):
     """Suppress ``lint`` findings globally, or only for programs whose name
     contains ``program``. Returns a token usable with
     :func:`remove_suppression`."""
-    if lint not in LINTS + MEM_LINTS and lint != "*":
+    if lint not in LINTS + MEM_LINTS + COMM_LINTS and lint != "*":
         raise MXNetError("tracecheck: unknown lint %r (have %s)"
-                         % (lint, ", ".join(LINTS + MEM_LINTS)))
+                         % (lint, ", ".join(LINTS + MEM_LINTS
+                                            + COMM_LINTS)))
     tok = (lint, program)
     _SUPPRESSIONS.add(tok)
     return tok
@@ -803,36 +805,25 @@ def check_collectives(fn, args=(), kwargs=None, name=None,
     ``sharding=``) — unsharded arguments compile an unpartitioned program
     with no collectives at all. Compiling is the cost of this check: use
     it on gates and tests, not in per-dispatch paths. Returns findings
-    with suppressions applied, like :func:`check_program`."""
-    import jax
-    kwargs = dict(kwargs or {})
-    if name is None:
-        name = getattr(fn, "__name__", None) or repr(fn)
-    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    txt = jitted.lower(*args, **kwargs).compile().as_text()
-    findings = []
-    for line in txt.splitlines():
-        m = _HLO_COLLECTIVE_RE.search(line)
-        if not m:
-            continue
-        kind = m.group(1)
-        if kind in (allow or ()):
-            continue
-        op = _HLO_OPNAME_RE.search(line)
-        op_name = op.group(1) if op else ""
-        if "/while/" not in op_name:
-            # outside the loop: a once-per-dispatch collective (e.g. a
-            # final output gather) is not this lint's business
-            continue
-        src = _HLO_SOURCE_RE.search(line)
-        prov = ("%s:%s" % (src.group(1), src.group(2))) if src else None
-        findings.append(Finding(
-            "collective-in-scan", name,
-            "compiled program runs %r inside the scan body (op %s) — the "
-            "partitioned K-step dispatch should sync only by all-reduce "
-            "(grad + metric psum); this collective pays its bandwidth K "
-            "times per dispatch" % (kind, op_name or "?"),
-            op_path=op_name or "while/body", provenance=prov))
+    with suppressions applied, like :func:`check_program`.
+
+    This is a thin alias over :mod:`mxnet_tpu.commscheck`'s collective
+    inventory pass (ONE collective parser for both analyzers) — the
+    findings keep this module's historical ``collective-in-scan`` lint
+    id, so existing suppressions and tests are unaffected; commscheck's
+    own generalization is the ``gather-in-loop`` lint."""
+    from . import commscheck as _cc
+    report = _cc.analyze(fn, args, kwargs=kwargs, name=name)
+    if report.hlo_unavailable:
+        # the pre-dedupe implementation read compiled.as_text() unguarded
+        # and raised; an empty-for-lack-of-evidence inventory must not
+        # become a silent [] under the same contract
+        raise MXNetError(
+            "check_collectives: compiled HLO text unavailable for %s — "
+            "cannot audit the partitioned program's collectives"
+            % report.program)
+    findings = _cc.loop_findings(report, report.program,
+                                 lint="collective-in-scan", allow=allow)
     for f in findings:
         f.suppressed = _is_suppressed(f)
     return findings

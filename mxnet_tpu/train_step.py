@@ -743,6 +743,18 @@ class TrainStep(object):
         if name not in _tc.PROGRAMS:
             _tc.register_program(name, jitfn, call_args,
                                  donate_argnums=(0,))
+            if self.mesh is not None:
+                # MXTPU_COMMSCHECK (docs/static_analysis.md
+                # "Communication lints"): one-time collective audit of a
+                # freshly compiled SHARDED program — off by default; warn/
+                # error pay one extra compile at the first dispatch. The
+                # call args are reduced to sharded structs inside, so the
+                # just-donated state buffers are never read.
+                from . import commscheck as _cc
+                trips = (cache_key[1] if isinstance(cache_key, tuple)
+                         else 1)
+                _cc.maybe_audit_dispatch(name, jitfn, call_args,
+                                         loop_trips=trips, mesh=self.mesh)
         try:
             self._watcher.after_call(key, jitfn, _tc.signature(call_args),
                                      health=self.health)
