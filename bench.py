@@ -47,6 +47,27 @@ Knobs: BENCH_SERVE_MODEL (mlp|lenet, default mlp), BENCH_SERVE_QPS
 (default 200), BENCH_SERVE_REQS (default 400), BENCH_SERVE_CLIENTS
 (default 4), plus the MXTPU_SERVE_* batcher knobs (docs/env_var.md).
 
+BENCH_FLEET=1 switches to the fleet latency bench (docs/serving.md "Fleet
+tier"): N replicas (each its own AOT engine + Batcher) behind a
+FleetRouter, open-loop arrivals at a QPS one replica cannot hold, a mixed
+interactive/batch class workload, and a MID-RUN drain + rejoin of one
+replica — reporting per-class p50/p99, achieved rps for the fleet AND for
+a single replica measured by the same harness (their ratio is the
+scaling number the BENCH_fleet_rNN.json gate pins), per-replica
+utilization, and requeued/shed/failed counts (drain+death must shed
+nothing). On hosts without a real accelerator the per-dispatch device
+time is EMULATED by a labeled GIL-free sleep (BENCH_FLEET_DEVICE_MS,
+default 40 — the emulation is printed in the JSON as emulated_device_ms;
+set 0 on real hardware): one CPU core cannot demonstrate replica
+parallelism, but the router/queue/drain path under test is fully real.
+Knobs: BENCH_FLEET_REPLICAS (2), BENCH_FLEET_QPS (500),
+BENCH_FLEET_REQS (600), BENCH_FLEET_SINGLE_REQS (200),
+BENCH_FLEET_MAX_BATCH (8 — with the emulated device time this pins one
+replica's capacity at max_batch/cycle, so both phases measure capacity),
+BENCH_FLEET_MODEL (mlp|lenet), BENCH_FLEET_BATCH_FRAC (0.25),
+BENCH_FLEET_DRAIN (1), BENCH_FLEET_DEADLINE_MS (20000), plus
+MXTPU_FLEET_* / MXTPU_SERVE_*.
+
 BENCH_REAL_DATA=1 switches to the real-data input-tier gate (docs/perf.md
 "Device-fed input pipeline"): generate a real-JPEG RecordIO set, run an
 epoch of the SAME model/batch/K through the full
@@ -372,11 +393,13 @@ def realdata_main():
             % (best_real, ratio, synth_ips, min_ratio))
 
 
-def _serve_model():
-    """Build (engine kwargs) for the serving bench: symbol + random
-    params at deploy-realistic shapes (weights don't affect latency)."""
+def _serve_model(name=None):
+    """Build (engine kwargs) for the serving/fleet benches: symbol +
+    random params at deploy-realistic shapes (weights don't affect
+    latency). ``name`` defaults to the BENCH_SERVE_MODEL env knob."""
     from mxnet_tpu import models
-    name = os.environ.get("BENCH_SERVE_MODEL", "mlp")
+    if name is None:
+        name = os.environ.get("BENCH_SERVE_MODEL", "mlp")
     if name == "lenet":
         sym = models.lenet(num_classes=10)
         shape = (1, 28, 28)
@@ -384,8 +407,8 @@ def _serve_model():
         sym = models.mlp(num_classes=10, hidden=(128,))
         shape = (64,)
     else:
-        raise SystemExit("BENCH_SERVE_MODEL must be mlp|lenet, got %r"
-                         % name)
+        raise SystemExit("bench serve/fleet model must be mlp|lenet, "
+                         "got %r" % name)
     probe = {"data": (2,) + shape, "softmax_label": (2,)}
     arg_shapes, _, _ = sym.infer_shape(
         **{k: v for k, v in probe.items()
@@ -497,6 +520,215 @@ def serve_main():
         "retraces": tracecheck.retrace_count(),
     }
     out.update(mem_fields)
+    print(json.dumps(out))
+
+
+class _PacedEngine(object):
+    """Bench-local engine proxy emulating device dispatch latency with a
+    GIL-free sleep: on a host without a real accelerator, one core cannot
+    demonstrate replica parallelism — the sleep stands in for the
+    accelerator's execution time (overlapping across replicas exactly like
+    real devices would) while the batcher/router/queue path under test
+    stays fully real. The emulation is labeled in the bench JSON
+    (``emulated_device_ms``); 0 disables it for real-hardware runs."""
+
+    def __init__(self, engine, device_ms):
+        self._engine = engine
+        self._device_s = device_ms / 1e3
+
+    def infer(self, inputs):
+        if self._device_s > 0:
+            time.sleep(self._device_s)
+        return self._engine.infer(inputs)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+def _percentiles_ms(latencies):
+    lat = np.asarray(latencies) * 1e3
+    return {"p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "mean_ms": round(float(lat.mean()), 3)}
+
+
+def _fleet_open_loop(router, inputs, nreq, qps, classes, deadline_ms):
+    """TRUE open-loop arrival harness for the fleet phases: one pacer
+    thread issues NON-BLOCKING submissions (request i DUE at t0 + i/qps —
+    queueing delay lands in measured latency, never caps the offered
+    load the way a pool of blocking clients would), completions are
+    timestamped by the router's settle callback. Returns (per-class
+    latency lists, errors, wall seconds from first due to last
+    completion)."""
+    import threading
+    lat = {c: [] for c in set(classes)}
+    errors = []
+    lock = threading.Lock()
+    interval = 1.0 / qps
+    done_ts = [0.0]
+
+    def make_cb(cls, t_start):
+        def cb(freq):
+            now = time.perf_counter()
+            with lock:
+                if freq.error is None:
+                    lat[cls].append(now - t_start)
+                else:
+                    errors.append(repr(freq.error))
+                done_ts[0] = max(done_ts[0], now)
+        return cb
+
+    futs = []
+    t0 = time.perf_counter() + 0.05
+    for i in range(nreq):
+        due = t0 + i * interval
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        # latency counts from the DUE time, not the actual submit
+        # instant: a pacer running late must charge its lag to the
+        # measured latency, not silently exclude it (coordinated
+        # omission)
+        try:
+            futs.append(router.submit(inputs, priority=classes[i],
+                                      deadline_ms=deadline_ms,
+                                      on_done=make_cb(classes[i], due)))
+        except Exception as e:
+            with lock:
+                errors.append(repr(e))
+    for f in futs:
+        f.event.wait(timeout=deadline_ms / 1e3 + 5.0)
+    return lat, errors, max(done_ts[0], t0) - t0
+
+
+def fleet_main():
+    """Fleet latency bench (docs/serving.md "Fleet tier"): N replicas
+    behind a FleetRouter at a QPS one replica cannot hold, with a mid-run
+    drain + rejoin; one JSON line with per-class latency, fleet-vs-single
+    achieved rps, per-replica utilization, and the static audit."""
+    import threading
+    from mxnet_tpu import serving, tracecheck
+
+    nrep = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
+    qps = float(os.environ.get("BENCH_FLEET_QPS", "500"))
+    nreq = int(os.environ.get("BENCH_FLEET_REQS", "600"))
+    nreq_single = int(os.environ.get("BENCH_FLEET_SINGLE_REQS", "200"))
+    batch_frac = float(os.environ.get("BENCH_FLEET_BATCH_FRAC", "0.25"))
+    device_ms = float(os.environ.get("BENCH_FLEET_DEVICE_MS", "40"))
+    deadline_ms = float(os.environ.get("BENCH_FLEET_DEADLINE_MS", "20000"))
+    # one dispatch serves at most this many co-riders: with the emulated
+    # device time this pins a replica's capacity (max_batch/cycle) well
+    # below the offered QPS, so BOTH phases measure capacity, not load
+    max_batch = int(os.environ.get("BENCH_FLEET_MAX_BATCH", "8"))
+    do_drain = os.environ.get("BENCH_FLEET_DRAIN", "1").strip() \
+        not in ("", "0")
+    name, sym, params, shape = _serve_model(
+        os.environ.get("BENCH_FLEET_MODEL", "mlp"))
+    rs = np.random.default_rng(1)
+    x1 = rs.normal(size=(1,) + shape).astype(np.float32)
+
+    def mk_replica():
+        eng = serving.ServingEngine(sym, params, {"data": shape})
+        return serving.Batcher(_PacedEngine(eng, device_ms),
+                               max_batch=max_batch)
+
+    # ---- phase A: ONE replica's achieved rps under the same open loop —
+    # the capacity the fleet must beat (completions per wall second at an
+    # offered load above what one replica can hold)
+    single = serving.FleetRouter([mk_replica()], name="fleet-single")
+    single.infer({"data": x1}, deadline_ms=deadline_ms)   # warm path
+    cls_single = ["interactive"] * nreq_single
+    lat1, err1, wall1 = _fleet_open_loop(single, {"data": x1},
+                                         nreq_single, qps, cls_single,
+                                         deadline_ms)
+    single.close()
+    done1 = sum(len(v) for v in lat1.values())
+    rps_single = done1 / wall1
+
+    # ---- phase B: the fleet, same open loop, mixed classes, and (by
+    # default) a mid-run drain of r0 + a warm rejoin while serving
+    replicas = {"r%d" % i: mk_replica() for i in range(nrep)}
+    r0_engine = replicas["r0"].engine
+    router = serving.FleetRouter(replicas, name="fleet-bench")
+    router.infer({"data": x1}, deadline_ms=deadline_ms)
+    stride = max(2, int(round(1.0 / batch_frac))) if batch_frac > 0 else 0
+    cls = ["batch" if (stride and i % stride == 0) else "interactive"
+           for i in range(nreq)]
+    drain_state = {"event": None}
+
+    def coordinator():
+        # fire the membership event once ~35% of the run has been issued
+        time.sleep(0.05 + (0.35 * nreq) / qps)
+        try:
+            router.drain("r0", timeout=60.0)
+            # warm rejoin: same engine (already compiled), fresh batcher —
+            # join() re-warms every bucket off the serving path
+            router.join("r0b",
+                        lambda: serving.Batcher(r0_engine,
+                                                max_batch=max_batch),
+                        warmup=True)
+            drain_state["event"] = "drain+join ok"
+        except Exception as e:
+            drain_state["event"] = "FAILED: %r" % (e,)
+
+    coord = None
+    if do_drain:
+        coord = threading.Thread(target=coordinator, daemon=True)
+        coord.start()
+    lat, errors, wall = _fleet_open_loop(router, {"data": x1}, nreq, qps,
+                                         cls, deadline_ms)
+    if coord is not None:
+        coord.join(timeout=90.0)
+    done = sum(len(v) for v in lat.values())
+    rps_fleet = done / wall
+    report = router.report()
+    # static audit across EVERY replica's program set (tracecheck +
+    # memory + comms lints; r0 and r0b share one engine/program set)
+    findings = [f for f in router.check(memory=True, comms=True)
+                if not f.suppressed]
+    # utilization per DISTINCT engine: a warm rejoin (r0b) shares r0's
+    # engine, so its counters must be attributed once, under a combined
+    # key, not double-counted per replica name
+    by_engine = {}
+    for rname, r in sorted(report["replicas"].items()):
+        key = r["engine"]
+        names, _ = by_engine.get(key, ([], 0))
+        by_engine[key] = (names + [rname], r["engine_health"]["examples"])
+    total_examples = sum(ex for _, ex in by_engine.values()) or 1
+    util = {"+".join(names): round(ex / total_examples, 3)
+            for names, ex in by_engine.values()}
+    router.close()
+    if not done:
+        raise RuntimeError("fleet bench completed no requests: %s"
+                           % errors[:3])
+    out = {
+        "metric": "fleet_%s_r%d_qps%g" % (name, nrep, qps),
+        "value": round(rps_fleet / max(rps_single, 1e-9), 3),
+        "unit": "x_single_replica_rps",
+        "replicas": nrep,
+        "qps_target": qps,
+        "rps_fleet": round(rps_fleet, 2),
+        "rps_single": round(rps_single, 2),
+        "scaling": round(rps_fleet / max(rps_single, 1e-9), 3),
+        "completed": done,
+        "failed": len(errors),
+        "single_phase_failed": len(err1),
+        "emulated_device_ms": device_ms,
+        "drain_event": drain_state["event"] if do_drain else "disabled",
+        "requeued": report["fleet"]["requeued"],
+        "shed": report["fleet"]["shed"],
+        "expired": report["fleet"]["expired"],
+        "dropped": report["fleet"]["dropped"],
+        "utilization": util,
+        "tracecheck_findings": len(findings),
+        "retraces": tracecheck.retrace_count(),
+    }
+    for c in serving.FLEET_CLASSES:
+        if lat.get(c):
+            out[c] = dict(_percentiles_ms(lat[c]),
+                          completed=len(lat[c]))
+    out["single"] = dict(_percentiles_ms(sum(lat1.values(), [])),
+                         completed=done1)
     print(json.dumps(out))
 
 
@@ -840,6 +1072,8 @@ def main():
 if __name__ == "__main__":
     if os.environ.get("BENCH_REAL_DATA", "").strip() not in ("", "0"):
         realdata_main()
+    elif os.environ.get("BENCH_FLEET", "").strip() not in ("", "0"):
+        fleet_main()
     elif os.environ.get("BENCH_SERVE", "").strip() not in ("", "0"):
         serve_main()
     elif os.environ.get("BENCH_HOST_OVERHEAD", "").strip() not in ("", "0"):

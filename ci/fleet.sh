@@ -1,0 +1,54 @@
+#!/bin/sh
+# CI gate: fleet-tier smoke (docs/serving.md "Fleet tier"). Two single-chip
+# mlp replicas behind a FleetRouter on CPU, open-loop load above one
+# replica's achieved rps (the per-dispatch device time is emulated — see
+# BENCH_FLEET_DEVICE_MS in bench.py — so replica capacity is wall-bound
+# and real on a 1-core host), mixed interactive/batch classes, and a
+# MID-RUN drain + warm rejoin of one replica. Asserts:
+#   (a) zero failed requests in BOTH phases (drain/join must shed nothing),
+#   (b) p99 per class under a deliberately generous cap,
+#   (c) zero unsuppressed tracecheck/memcheck/commscheck findings across
+#       EVERY replica's program set,
+#   (d) the drain+join event completed,
+#   (e) a loose scaling sanity floor (the committed BENCH_fleet_rNN.json
+#       pins the real >= 1.8x number; this is a works-at-all smoke).
+#
+# Usage: ci/fleet.sh [p99_cap_ms]   (default 3000)
+set -e
+cd "$(dirname "$0")/.."
+CAP_MS="${1:-3000}"
+echo "ci/fleet.sh: 2 mlp replicas, qps 500, mid-run drain+rejoin"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    BENCH_FLEET=1 BENCH_FLEET_REPLICAS=2 \
+    BENCH_FLEET_REQS=240 BENCH_FLEET_SINGLE_REQS=100 \
+    python bench.py | tail -n 1 | CAP_MS="$CAP_MS" python -c '
+import json, os, sys
+r = json.loads(sys.stdin.readline())
+cap = float(os.environ["CAP_MS"])
+bad = []
+if r["failed"] or r["single_phase_failed"]:
+    bad.append("%d fleet / %d single-phase requests failed"
+               % (r["failed"], r["single_phase_failed"]))
+if r["shed"]:
+    bad.append("%d requests shed (drain/death must re-queue, not shed)"
+               % r["shed"])
+if r["drain_event"] != "drain+join ok":
+    bad.append("drain/join event: %s" % r["drain_event"])
+if r["tracecheck_findings"]:
+    bad.append("%d static findings across the replica program sets"
+               % r["tracecheck_findings"])
+for cls in ("interactive", "batch"):
+    if cls in r and r[cls]["p99_ms"] > cap:
+        bad.append("%s p99 %.1f ms over the %.0f ms smoke cap"
+                   % (cls, r[cls]["p99_ms"], cap))
+if r["scaling"] < 1.2:
+    bad.append("fleet rps only %.2fx one replica (smoke floor 1.2x; "
+               "the committed bench pins >= 1.8x)" % r["scaling"])
+if bad:
+    sys.exit("ci/fleet.sh FAIL (%s): %s" % (r["metric"], "; ".join(bad)))
+print("  %s: scaling %.2fx (%.1f vs %.1f rps), interactive p99 %.1f ms, "
+      "batch p99 %.1f ms, requeued %d, shed 0, findings 0"
+      % (r["metric"], r["scaling"], r["rps_fleet"], r["rps_single"],
+         r["interactive"]["p99_ms"], r["batch"]["p99_ms"], r["requeued"]))
+'
+echo "fleet smoke PASS"

@@ -31,6 +31,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
 # tracecheck findings on the serving program set (docs/serving.md)
 ./ci/serve.sh
+# fleet-tier smoke (docs/serving.md "Fleet tier"): 2 replicas behind the
+# priority-aware router at a QPS one replica cannot hold, mid-run
+# drain+rejoin; zero failed/shed requests, per-class p99 cap, zero
+# static findings across every replica's program set
+./ci/fleet.sh
 # real-data input-tier smoke (docs/perf.md "Device-fed input pipeline"):
 # small real-JPEG epoch through reader -> decode workers -> prefetch ->
 # fused scan; gates the real/synthetic throughput ratio floor

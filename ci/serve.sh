@@ -29,6 +29,11 @@ if r["failed"]:
     bad.append("%d requests failed" % r["failed"])
 if r["p99_ms"] > cap:
     bad.append("p99 %.1f ms over the %.0f ms smoke cap" % (r["p99_ms"], cap))
+if r["p50_ms"] >= 50.0:
+    # regression guard for the old 50 ms wait() poll quantum: at this low
+    # QPS a served request must resolve well inside one former poll step
+    bad.append("p50 %.1f ms not sub-poll-interval (< 50 ms) — wait() is "
+               "quantizing latency again" % r["p50_ms"])
 if bad:
     sys.exit("ci/serve.sh FAIL (%s): %s" % (r["metric"], "; ".join(bad)))
 print("  %s: p50 %.2f ms, p99 %.2f ms, %.1f req/s, findings 0"

@@ -83,6 +83,22 @@ def env_float(name, default):
         raise MXNetError("%s must be a number, got %r" % (name, v))
 
 
+def env_int(name, default):
+    """Parse an env var as an integer knob (the MXTPU_SERVE_QUEUE /
+    MXTPU_FLEET_* readers share this); unset or blank means ``default``.
+    Non-integer spellings (including float syntax like "256.5") raise an
+    :class:`MXNetError` naming the variable instead of silently
+    truncating."""
+    import os
+    v = os.environ.get(name)
+    if v is None or v.strip() == "":
+        return default
+    try:
+        return int(v.strip())
+    except ValueError:
+        raise MXNetError("%s must be an integer, got %r" % (name, v))
+
+
 def env_bool(name):
     """Parse an env var as an on/off switch (MXTPU_GUARD / MXTPU_ASYNC_CKPT
     share this so the disable spellings can never drift apart): unset,

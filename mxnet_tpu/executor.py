@@ -31,14 +31,20 @@ from .symbol import Symbol, _topo
 from . import random as _random
 
 
-def _build_graph_runner(symbol, placement=None):
+def _build_graph_runner(symbol, placement=None, node_constraint=None):
     """Lower the symbol DAG to a pure function
     run(arg_vals: dict, aux_vals: dict, key, is_train) -> (outputs, aux_updates).
 
     ``placement`` (parallel.placement.GroupPlacement) lowers ctx_group
     annotations to per-node sharding constraints — the SPMD analog of the
     reference's PlaceDevice pass + _CrossDeviceCopy insertion
-    (ref: src/executor/graph_executor.cc:244-334)."""
+    (ref: src/executor/graph_executor.cc:244-334).
+
+    ``node_constraint`` (callable ``(node, outs) -> outs``, trace-time) is
+    a caller-supplied sharding hook applied to every non-variable node's
+    outputs — the serving tier uses it to keep activations replicated at
+    the graph edges of a model-axis-sharded engine (docs/serving.md
+    "Model-parallel replicas") without annotating the symbol."""
     nodes = _topo(symbol._out_nodes())
     node_groups = {}
     if placement is not None:
@@ -116,6 +122,8 @@ def _build_graph_runner(symbol, placement=None):
                 with jax.named_scope("%s:%s" % (node.op.name, node.name)):
                     outs, aux_up = node.op.apply(op_ctx, node.attrs, ins,
                                                  aux_in)
+            if node_constraint is not None:
+                outs = node_constraint(node, outs)
             g = node_groups.get(id(node))
             if g is not None:
                 outs = [placement.constrain(g, o) for o in outs]

@@ -46,6 +46,15 @@ site                         where it fires
                              — ``"die"`` (or any raising kind) kills the
                              loop thread, which sheds every in-flight and
                              queued sequence with ``ServingClosedError``
+``fleet.replica_die``        once per collected batch on every
+                             fleet-managed replica's batching thread —
+                             ``"die"`` (or any raising kind) kills that
+                             replica; the ``serving.FleetRouter`` detects
+                             the death and RE-QUEUES the replica's
+                             queued-but-undispatched requests onto the
+                             surviving replicas (no hang, no silent shed;
+                             only requests whose engine dispatch had
+                             already started fail)
 ``data.worker_die``          per claimed batch task in a
                              ``data.DecodeWorkerPool`` worker — ``"die"``
                              kills that worker abruptly (no sentinel); the

@@ -55,6 +55,23 @@ def data_parallel_mesh(num=None, devices=None):
     return make_mesh({AXIS_DATA: len(devices)}, devices)
 
 
+def model_parallel_mesh(num=None, devices=None):
+    """One-axis 'model' mesh — the serving tier's bigger-than-one-chip
+    substrate: a ServingEngine/DecodeLoop built over N contexts compiles
+    each program with params sharded over this axis
+    (docs/serving.md "Model-parallel replicas")."""
+    if devices is None:
+        devices = jax.devices()
+    if num is not None:
+        if num > len(devices):
+            raise MXNetError(
+                "model_parallel_mesh: %d devices requested, %d visible "
+                "(on CPU, raise XLA_FLAGS=--xla_force_host_platform_"
+                "device_count)" % (num, len(devices)))
+        devices = devices[:num]
+    return make_mesh({AXIS_MODEL: len(devices)}, devices)
+
+
 class MeshScope(object):
     """with MeshScope(mesh): — sets the ambient mesh for Module/KVStore."""
 

@@ -82,6 +82,17 @@ def _auto_spec(axis, shape, mesh, prefer_first=False):
     return None
 
 
+def auto_spec(axis, shape, mesh, prefer_first=False):
+    """Public spelling of the group2ctx auto-sharding rule (one dimension
+    sharded over ``axis``; ``prefer_first=True`` is the parameter rule —
+    first divisible dim, i.e. the OUTPUT dim of a (out, in) weight, so
+    matmul contraction dims never split and sharded forwards stay bitwise
+    with their single-chip runs). ``None`` when no dim divides. The
+    serving tier shards checkpoints with this exact rule
+    (docs/serving.md "Model-parallel replicas")."""
+    return _auto_spec(axis, shape, mesh, prefer_first=prefer_first)
+
+
 def _spec_axes(rule):
     """All mesh axis names a rule refers to."""
     if isinstance(rule, str):

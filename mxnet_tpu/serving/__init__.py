@@ -13,6 +13,10 @@ Three layers over the standalone :class:`~mxnet_tpu.predictor.Predictor`:
 * :class:`DecodeLoop` — slot-based continuous batching for the
   transformer LM: the KV cache is donated device state stepped by one
   compiled decode body; sequences join and leave mid-stream.
+* :class:`FleetRouter` — N data-parallel replicas (each its own engine +
+  batcher, single-chip or model-axis-sharded via
+  ``ServingEngine(contexts=...)``) behind priority-aware least-loaded
+  dispatch with elastic drain/join and death re-queue (``MXTPU_FLEET_*``).
 
 Degradation is counted in :class:`ServingHealth` (process-global aggregate
 ``serving.SERVING_HEALTH``), mirroring ``io.DATA_HEALTH`` /
@@ -23,9 +27,11 @@ from .engine import ServingEngine, default_buckets
 from .batcher import (Batcher, ServingError, ServingDeadlineError,
                       ServingOverloadedError, ServingClosedError)
 from .decode import DecodeLoop, GenerateFuture
+from .fleet import FleetRouter, FleetRequest, CLASSES as FLEET_CLASSES
 
 __all__ = [
     "ServingEngine", "Batcher", "DecodeLoop", "GenerateFuture",
+    "FleetRouter", "FleetRequest", "FLEET_CLASSES",
     "ServingHealth", "SERVING_HEALTH", "default_buckets",
     "ServingError", "ServingDeadlineError", "ServingOverloadedError",
     "ServingClosedError",

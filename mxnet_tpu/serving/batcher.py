@@ -32,14 +32,21 @@ hanging callers.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 
 import numpy as np
 
-from ..base import MXNetError, env_float
+from ..base import MXNetError, env_float, env_int
 from .health import ServingHealth, SERVING_HEALTH
+
+#: how often a blocked ``wait()``/drain re-checks batching-thread liveness
+#: while sleeping toward the request's actual deadline (a dead thread is
+#: rare; the deadline is the contract — so the wait is event-driven and
+#: only wakes at this cadence for the liveness probe)
+_LIVENESS_RECHECK_S = 0.2
 
 
 class ServingError(MXNetError):
@@ -59,24 +66,65 @@ class ServingClosedError(ServingError):
     """The batcher/loop is closed (or died) — the request was shed."""
 
 
-class _Request(object):
-    __slots__ = ("inputs", "n", "deadline", "event", "result", "error")
+class Settleable(object):
+    """Once-only request settle protocol shared by the batcher's
+    :class:`_Request` and the fleet's
+    :class:`~mxnet_tpu.serving.fleet.FleetRequest`: first settle wins (the
+    serving thread fulfilling vs. a waiter expiring the deadline race on
+    the same request), the event is set before the ``on_done`` callback
+    runs, and a callback exception can never kill the settling thread."""
 
-    def __init__(self, inputs, n, deadline):
+    __slots__ = ("event", "value", "error", "on_done", "_settle_lock")
+
+    def __init__(self, on_done=None):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+        #: optional callback fired exactly once, after the request
+        #: settles, from whichever thread settles it
+        self.on_done = on_done
+        self._settle_lock = threading.Lock()
+
+    def _settle(self, result, error):
+        """Returns whether THIS call settled the request."""
+        with self._settle_lock:
+            if self.event.is_set():
+                return False
+            self.value = result
+            self.error = error
+            self.event.set()
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                # a completion callback must never kill the settling thread
+                logging.exception("serving: request on_done callback failed")
+        return True
+
+    def fail(self, exc):
+        return self._settle(None, exc)
+
+    def fulfill(self, outs):
+        return self._settle(outs, None)
+
+    def done(self):
+        return self.event.is_set()
+
+
+class _Request(Settleable):
+    __slots__ = ("inputs", "n", "deadline", "dispatched")
+
+    def __init__(self, inputs, n, deadline, on_done=None):
+        super().__init__(on_done=on_done)
         self.inputs = inputs
         self.n = n
         self.deadline = deadline
-        self.event = threading.Event()
-        self.result = None
-        self.error = None
-
-    def fail(self, exc):
-        self.error = exc
-        self.event.set()
-
-    def fulfill(self, outs):
-        self.result = outs
-        self.event.set()
+        #: True once the batching thread has started executing this
+        #: request's engine dispatch — the fleet router uses it to tell a
+        #: safely-retryable request (never ran) from one that may have
+        #: side-effected (docs/serving.md "Fleet tier")
+        self.dispatched = False
 
 
 class Batcher(object):
@@ -90,7 +138,8 @@ class Batcher(object):
     """
 
     def __init__(self, engine, max_batch=None, max_latency_ms=None,
-                 queue_size=None, deadline_ms=None, health=None, start=True):
+                 queue_size=None, deadline_ms=None, health=None, start=True,
+                 fault_site=None):
         self.engine = engine
         self.max_batch = int(max_batch if max_batch is not None
                              else env_float("MXTPU_SERVE_MAX_BATCH",
@@ -106,10 +155,19 @@ class Batcher(object):
             deadline_ms if deadline_ms is not None
             else env_float("MXTPU_SERVE_DEADLINE_MS", 1000.0)) / 1e3
         qsize = int(queue_size if queue_size is not None
-                    else env_float("MXTPU_SERVE_QUEUE", 256))
+                    else env_int("MXTPU_SERVE_QUEUE", 256))
         self._queue = queue.Queue(maxsize=qsize)
         self._carry = None      # request popped but not fitting the batch
         self._closed = False
+        #: serializes submit-enqueue against close-shed: a submit that
+        #: passed the _closed check can no longer slip its request into the
+        #: queue AFTER close() drained it (the request would never resolve)
+        self._lock = threading.Lock()
+        self._inflight = ()     # requests popped into the batch being built
+        self.dead = None        # the exception that killed the thread
+        #: optional faults.py site fired once per collected batch (the
+        #: fleet router arms ``fleet.replica_die`` here)
+        self._fault_site = fault_site
         self.health = health or ServingHealth(parent=SERVING_HEALTH)
         self._thread = None
         if start:
@@ -119,6 +177,7 @@ class Batcher(object):
     def start(self):
         if self._thread is None or not self._thread.is_alive():
             self._closed = False
+            self.dead = None
             self._thread = threading.Thread(target=self._run,
                                             name="mxtpu-serve-batcher",
                                             daemon=True)
@@ -127,25 +186,46 @@ class Batcher(object):
 
     def close(self):
         """Stop the batching thread and shed everything still queued."""
-        self._closed = True
+        with self._lock:
+            self._closed = True
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        # re-shed AFTER the join, atomically against submit: any request
+        # that won the enqueue race is in the queue by now and is failed
+        # here; any later submit fails fast on the _closed check
         self._shed(ServingClosedError("batcher closed"))
 
+    def take_queued(self):
+        """Atomically remove and return every queued-but-undispatched
+        request (queue + carry) WITHOUT failing them — the fleet router's
+        drain/death path re-queues these onto surviving replicas instead
+        of shedding them (docs/serving.md "Fleet tier")."""
+        with self._lock:
+            taken = []
+            if self._carry is not None:
+                taken.append(self._carry)
+                self._carry = None
+            while True:
+                try:
+                    taken.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            return taken
+
+    def backlog(self):
+        """Queued-but-undispatched request count (queue + carry) — the
+        least-loaded dispatch signal and the drain-completion probe."""
+        return self._queue.qsize() + (1 if self._carry is not None else 0)
+
     def _shed(self, exc):
-        shed = 0
-        if self._carry is not None:
-            self._carry.fail(exc)
-            self._carry = None
-            shed += 1
-        while True:
-            try:
-                self._queue.get_nowait().fail(exc)
-                shed += 1
-            except queue.Empty:
-                break
-        if shed:
-            self.health.record_shed(shed, exc)
+        # collect under the lock, fail OUTSIDE it: request on_done
+        # callbacks (the fleet router's completion hook) take their own
+        # locks and must never run under ours
+        taken = self.take_queued()
+        for r in taken:
+            r.fail(exc)
+        if taken:
+            self.health.record_shed(len(taken), exc)
 
     # ------------------------------------------------------------------
     def infer(self, inputs, deadline_ms=None):
@@ -154,14 +234,18 @@ class Batcher(object):
         req = self.submit(inputs, deadline_ms=deadline_ms)
         return self.wait(req)
 
-    def submit(self, inputs, deadline_ms=None):
+    def submit(self, inputs, deadline_ms=None, on_done=None):
         """Enqueue without blocking on the result; returns the request
-        handle for :meth:`wait`."""
+        handle for :meth:`wait`. ``on_done`` (if given) is called with the
+        request exactly once, after it settles — fulfilled, failed, or
+        shed — from whichever thread settles it."""
         from .. import faults as _faults
         if self._closed:
             raise ServingClosedError("batcher is closed")
         if self._thread is not None and not self._thread.is_alive():
-            raise ServingClosedError("batching thread died")
+            raise ServingClosedError(
+                "batching thread died" if self.dead is None
+                else "batching thread died: %r" % (self.dead,))
         n = None
         host = {}
         for name in self.engine._input_names:
@@ -196,36 +280,51 @@ class Batcher(object):
         deadline = time.monotonic() + (
             (deadline_ms / 1e3) if deadline_ms is not None
             else self.default_deadline)
-        req = _Request(host, n, deadline)
-        try:
-            self._queue.put_nowait(req)
-        except queue.Full:
-            err = ServingOverloadedError(
-                "request queue full (%d waiting) — the serving tier is "
-                "saturated; shed at the edge" % self._queue.maxsize)
-            self.health.record_dropped(err)
-            raise err
+        req = _Request(host, n, deadline, on_done=on_done)
+        # the _closed re-check and the enqueue are ATOMIC against
+        # close()'s final shed: without the lock a submit could pass the
+        # check, lose the CPU, and enqueue after close() drained the
+        # queue — a request nothing would ever resolve
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError("batcher is closed")
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                err = ServingOverloadedError(
+                    "request queue full (%d waiting) — the serving tier is "
+                    "saturated; shed at the edge" % self._queue.maxsize)
+                self.health.record_dropped(err)
+                raise err
         self.health.record_request()
         return req
 
     def wait(self, req):
-        """Block until ``req`` resolves; raises its error if it failed."""
-        while not req.event.wait(0.05):
-            if (self._thread is not None and not self._thread.is_alive()
-                    and not req.event.is_set()):
+        """Block until ``req`` resolves; raises its error if it failed.
+
+        The wait is event-driven against the request's ACTUAL remaining
+        deadline (not a fixed poll quantum — a 50 ms poll step would both
+        quantize every caller's deadline handling and wake 20x/s for
+        nothing), with a bounded-cadence liveness re-check so a dead
+        batching thread still fails the caller promptly."""
+        while not req.event.is_set():
+            remaining = req.deadline - time.monotonic()
+            if remaining <= 0:
+                # the batcher also expires queued requests; this covers a
+                # request stuck behind a long-running dispatch
+                if req.fail(ServingDeadlineError(
+                        "deadline passed while waiting for dispatch")):
+                    self.health.record_expired(req.error)
+                break
+            if req.event.wait(min(remaining, _LIVENESS_RECHECK_S)):
+                break
+            if self._thread is not None and not self._thread.is_alive():
                 req.fail(ServingClosedError(
                     "batching thread died with the request in flight"))
                 break
-            if time.monotonic() > req.deadline and not req.event.is_set():
-                # the batcher also expires queued requests; this covers a
-                # request stuck behind a long-running dispatch
-                req.fail(ServingDeadlineError(
-                    "deadline passed while waiting for dispatch"))
-                self.health.record_expired(req.error)
-                break
         if req.error is not None:
             raise req.error
-        return req.result
+        return req.value
 
     # ------------------------------------------------------------------
     def _next_request(self, timeout):
@@ -238,36 +337,58 @@ class Batcher(object):
             return None
 
     def _run(self):
-        while not self._closed:
-            req = self._next_request(0.05)
-            if req is None:
-                continue
-            now = time.monotonic()
-            if now > req.deadline:
-                req.fail(ServingDeadlineError("expired in queue"))
-                self.health.record_expired(req.error)
-                continue
-            batch = [req]
-            total = req.n
-            flush_at = now + self.max_latency
-            while total < self.max_batch and not self._closed:
-                remaining = flush_at - time.monotonic()
-                if remaining <= 0:
-                    break
-                nxt = self._next_request(remaining)
-                if nxt is None:
-                    break
-                if time.monotonic() > nxt.deadline:
-                    nxt.fail(ServingDeadlineError("expired in queue"))
-                    self.health.record_expired(nxt.error)
+        try:
+            while not self._closed:
+                req = self._next_request(0.05)
+                if req is None:
                     continue
-                if total + nxt.n > self.max_batch:
-                    self._carry = nxt
-                    break
-                batch.append(nxt)
-                total += nxt.n
-            self._dispatch(batch, total)
-        # closing: anything still queued is shed by close()
+                now = time.monotonic()
+                if now > req.deadline:
+                    req.fail(ServingDeadlineError("expired in queue"))
+                    self.health.record_expired(req.error)
+                    continue
+                batch = [req]
+                self._inflight = batch
+                total = req.n
+                flush_at = now + self.max_latency
+                while total < self.max_batch and not self._closed:
+                    remaining = flush_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    nxt = self._next_request(remaining)
+                    if nxt is None:
+                        break
+                    if time.monotonic() > nxt.deadline:
+                        nxt.fail(ServingDeadlineError("expired in queue"))
+                        self.health.record_expired(nxt.error)
+                        continue
+                    if total + nxt.n > self.max_batch:
+                        self._carry = nxt
+                        break
+                    batch.append(nxt)
+                    total += nxt.n
+                if self._fault_site is not None:
+                    from .. import faults as _faults
+                    act = _faults.fire(self._fault_site)
+                    if act == "die":
+                        raise MXNetError("injected replica death (%s)"
+                                         % self._fault_site)
+                self._dispatch(batch, total)
+                self._inflight = ()
+            # closing: anything still queued is shed by close()
+        except BaseException as e:
+            # the thread dies VISIBLY: record why, and settle the popped
+            # batch so no caller blocks on a request nothing owns. Popped
+            # requests that never started their engine dispatch keep
+            # dispatched=False — the fleet router's on_done hook re-queues
+            # those onto surviving replicas instead of failing the caller.
+            self.dead = e
+            inflight, self._inflight = self._inflight, ()
+            for r in inflight:
+                r.fail(ServingClosedError(
+                    "batching thread died: %r — request shed" % (e,)))
+            if inflight:
+                self.health.record_shed(len(inflight), e)
 
     def _dispatch(self, batch, total):
         names = self.engine._input_names
@@ -277,6 +398,10 @@ class Batcher(object):
             else:
                 stacked = {n: np.concatenate([r.inputs[n] for r in batch])
                            for n in names}
+            # past this point the requests may have side-effected: a fleet
+            # death must FAIL them, not silently retry them elsewhere
+            for r in batch:
+                r.dispatched = True
             outs = self.engine.infer(stacked)
         except Exception as e:
             for r in batch:

@@ -49,19 +49,35 @@ def _ln(x, gamma, beta):
     return (x - mean) * jax.lax.rsqrt(var + jnp.float32(1e-5)) * gamma + beta
 
 
-def _build_decode_fn(num_layers, num_heads):
+def _build_decode_fn(num_layers, num_heads, mesh=None):
     """The decode body: one token per slot through every layer, reading
     and writing the (layers, slots, heads, max_len, head_dim) KV cache.
     Matches models/transformer.py op-for-op (pre-LN blocks, qkv packing,
-    1/sqrt(d) scaling) so greedy decode agrees with the full forward."""
+    1/sqrt(d) scaling) so greedy decode agrees with the full forward.
+
+    With a model ``mesh`` the residual stream is pinned REPLICATED at
+    every block boundary while the KV cache and the attention math stay
+    sharded over heads — per-head contractions never cross shards, so the
+    sharded loop emits the same greedy tokens as the single-chip one
+    (docs/serving.md "Model-parallel replicas")."""
     import jax.numpy as jnp
     import jax
+
+    if mesh is not None:
+        _repl = jax.sharding.NamedSharding(mesh,
+                                           jax.sharding.PartitionSpec())
+
+        def edge(x):
+            return jax.lax.with_sharding_constraint(x, _repl)
+    else:
+        def edge(x):
+            return x
 
     def decode_fn(cache, params, tokens, pos):
         ck, cv = cache["k"], cache["v"]
         nslots = tokens.shape[0]
-        x = (params["tok_embed_weight"][tokens]
-             + params["pos_embed_weight"][pos])
+        x = edge(params["tok_embed_weight"][tokens]
+                 + params["pos_embed_weight"][pos])
         embed = x.shape[1]
         d = embed // num_heads
         scale = jnp.float32(1.0 / float(np.sqrt(d)))
@@ -84,14 +100,14 @@ def _build_decode_fn(num_layers, num_heads):
             o = jnp.einsum("sht,shtd->shd", w, cv[i]).reshape(nslots, embed)
             o = o @ params[pre + "_attn_out_weight"].T \
                 + params[pre + "_attn_out_bias"]
-            x = x + o
+            x = edge(x + o)
             f = _ln(x, params[pre + "_ln2_gamma"], params[pre + "_ln2_beta"])
             f = jnp.maximum(
                 f @ params[pre + "_ffn_fc1_weight"].T
                 + params[pre + "_ffn_fc1_bias"], jnp.float32(0.0))
             f = f @ params[pre + "_ffn_fc2_weight"].T \
                 + params[pre + "_ffn_fc2_bias"]
-            x = x + f
+            x = edge(x + f)
         x = _ln(x, params["final_ln_gamma"], params["final_ln_beta"])
         logits = x @ params["lm_head_weight"].T + params["lm_head_bias"]
         return {"k": ck, "v": cv}, logits
@@ -162,21 +178,45 @@ class DecodeLoop(object):
     """
 
     def __init__(self, params, num_layers, num_heads, max_len, slots=4,
-                 eos_id=None, health=None, name=None):
+                 eos_id=None, health=None, name=None, contexts=None):
         import jax
         import jax.numpy as jnp
         from .. import tracecheck as _tc
+        from .engine import _model_mesh
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.max_len = int(max_len)
         self.slots = int(slots)
         self.eos_id = eos_id
         self.health = health or ServingHealth(parent=SERVING_HEALTH)
+        #: model-axis mesh when the loop spans more than one chip: the KV
+        #: cache (the dominant buffer) shards over HEADS, params shard per
+        #: the placement rule, the residual stream stays replicated at
+        #: block edges (docs/serving.md "Model-parallel replicas")
+        self._mesh = _model_mesh(contexts, who="DecodeLoop")
+        if self._mesh is not None:
+            nshard = int(self._mesh.devices.size)
+            if self.num_heads % nshard:
+                raise MXNetError(
+                    "DecodeLoop: num_heads %d %% %d model shards != 0 — "
+                    "the KV cache shards over heads" % (self.num_heads,
+                                                        nshard))
+
+        def _place_param(arr):
+            if self._mesh is None:
+                return arr
+            from ..parallel import placement as _pl
+            from ..parallel.mesh import AXIS_MODEL
+            spec = _pl.auto_spec(AXIS_MODEL, tuple(arr.shape), self._mesh,
+                                 prefer_first=True)
+            return jax.device_put(arr, jax.sharding.NamedSharding(
+                self._mesh, spec or jax.sharding.PartitionSpec()))
 
         self._params = {}
         for k, v in params.items():
             data = getattr(v, "data", v)
-            self._params[k] = jnp.asarray(np.asarray(data, np.float32))
+            self._params[k] = _place_param(
+                jnp.asarray(np.asarray(data, np.float32)))
         for need in ("tok_embed_weight", "pos_embed_weight",
                      "final_ln_gamma", "lm_head_weight", "lm_head_bias"):
             if need not in self._params:
@@ -202,9 +242,17 @@ class DecodeLoop(object):
                        self.max_len, head_dim)
         self._cache = {"k": jnp.zeros(cache_shape, np.float32),
                        "v": jnp.zeros(cache_shape, np.float32)}
+        if self._mesh is not None:
+            from ..parallel.mesh import AXIS_MODEL
+            cache_sh = jax.sharding.NamedSharding(
+                self._mesh,
+                jax.sharding.PartitionSpec(None, None, AXIS_MODEL))
+            self._cache = {k: jax.device_put(v, cache_sh)
+                           for k, v in self._cache.items()}
 
         self.name = _tc.unique_name(name or "serving-decode")
-        jfn = jax.jit(_build_decode_fn(self.num_layers, self.num_heads),
+        jfn = jax.jit(_build_decode_fn(self.num_layers, self.num_heads,
+                                       mesh=self._mesh),
                       donate_argnums=(0,))
         structs = self._structs(jax)
         # AOT: the decode body compiles at LOAD time and registers with the
@@ -216,11 +264,13 @@ class DecodeLoop(object):
             "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
                                           self.max_len),
             jfn, structs, donate_argnums=(0,))
-        # MXTPU_MEMCHECK: audit the decode body's memory at LOAD time —
+        # MXTPU_MEMCHECK / MXTPU_COMMSCHECK: audit the decode body's
+        # memory and (when sharded) collective inventory at LOAD time —
         # the KV cache is the dominant buffer and scales with
         # slots*max_len, so a misconfigured loop fails here, not mid-fleet
-        from .engine import _audit_load_memory
+        from .engine import _audit_load_memory, _audit_load_comms
         _audit_load_memory(self, "DecodeLoop")
+        _audit_load_comms(self, "DecodeLoop")
 
         self._join_q = queue.Queue()
         self._slots = [None] * self.slots
@@ -234,11 +284,26 @@ class DecodeLoop(object):
 
     def _structs(self, jax):
         def sds(x):
+            sh = getattr(x, "sharding", None)
+            if (self._mesh is not None
+                    and isinstance(sh, jax.sharding.NamedSharding)):
+                return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                            sharding=sh)
             return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
         cache_s = {k: sds(v) for k, v in self._cache.items()}
         params_s = {k: sds(v) for k, v in self._params.items()}
-        tok_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
-        pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
+        repl = None
+        if self._mesh is not None:
+            repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+        if repl is not None:
+            tok_s = jax.ShapeDtypeStruct((self.slots,), np.int32,
+                                         sharding=repl)
+            pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32,
+                                         sharding=repl)
+        else:
+            tok_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
+            pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
         return cache_s, params_s, tok_s, pos_s
 
     # ------------------------------------------------------------------
@@ -336,9 +401,16 @@ class DecodeLoop(object):
             if slot is not None:
                 tokens[i] = slot.next_token
                 pos[i] = slot.pos
+        if self._mesh is None:
+            dev_tokens, dev_pos = jnp.asarray(tokens), jnp.asarray(pos)
+        else:
+            import jax
+            repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+            dev_tokens = jax.device_put(tokens, repl)
+            dev_pos = jax.device_put(pos, repl)
         new_cache, logits = self._compiled(
-            self._cache, self._params, jnp.asarray(tokens),
-            jnp.asarray(pos))
+            self._cache, self._params, dev_tokens, dev_pos)
         self._cache = new_cache
         host_logits = np.asarray(logits)   # the one per-step readback
         self.health.record_decode_step()
@@ -390,10 +462,33 @@ class DecodeLoop(object):
                 "(%s) — skipped from the memory audit", e)
             return {}
 
-    def check(self, const_bytes=None, memory=False, budget=None):
+    def comms_report(self):
+        """Static collective inventory of the compiled decode body
+        (``{program_name: CommsReport}``) — the per-token partitioning
+        bill of a sharded loop; zero collectives single-chip. Mirrors
+        :meth:`ServingEngine.comms_report` (skip-with-warning on
+        executables that cannot surface HLO text)."""
+        from .. import commscheck as _cc
+        import logging
+        name = "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
+                                             self.max_len)
+        try:
+            return {name: _cc.analyze_compiled(self._compiled, name,
+                                               mesh=self._mesh)}
+        except Exception as e:
+            logging.warning(
+                "DecodeLoop: compiled decode body cannot report its "
+                "collectives (%s) — skipped from the comms audit", e)
+            return {}
+
+    def check(self, const_bytes=None, memory=False, budget=None,
+              comms=False, min_eff=0.0):
         """Static-analyze the registered decode program; returns findings
         (the CI serving gate asserts none — docs/serving.md).
-        ``memory=True`` adds the memory lints over the compiled body."""
+        ``memory=True`` adds the memory lints over the compiled body;
+        ``comms=True`` the communication lints (``min_eff`` defaults to 0
+        like :meth:`ServingEngine.check` — the efficiency floor is a
+        training-scale gate)."""
         from .. import tracecheck as _tc
         findings = _tc.check_registered(const_bytes=const_bytes,
                                         match=self.name + "/")
@@ -401,4 +496,8 @@ class DecodeLoop(object):
             from .. import memcheck as _mc
             for rep in self.memory_report().values():
                 findings += _mc.lint_report(rep, budget=budget)
+        if comms:
+            from .. import commscheck as _cc
+            for rep in self.comms_report().values():
+                findings += _cc.lint_report(rep, min_eff=min_eff)
         return findings

@@ -52,6 +52,69 @@ def default_buckets():
     return buckets
 
 
+def _model_mesh(contexts, who="ServingEngine"):
+    """Resolve ``contexts=`` (int N, or a list of Context/jax.Device) to a
+    one-axis 'model' mesh — or None for the single-chip path. The mesh is
+    the unit one REPLICA serves from: a fleet runs N of these side by side
+    (docs/serving.md "Model-parallel replicas")."""
+    if not contexts:
+        return None
+    import jax
+    from ..context import Context
+    from ..parallel import mesh as _mesh
+    if isinstance(contexts, int):
+        if contexts <= 1:
+            return None
+        return _mesh.model_parallel_mesh(contexts, jax.local_devices())
+    devs = [c.to_device() if isinstance(c, Context) else c
+            for c in contexts]
+    if len(devs) <= 1:
+        return None
+    if len(set(devs)) != len(devs):
+        raise MXNetError(
+            "%s: contexts resolve to duplicate devices %r — each model "
+            "shard needs its own chip" % (who, devs))
+    return _mesh.make_mesh({_mesh.AXIS_MODEL: len(devs)}, devs)
+
+
+def _audit_load_comms(obj, who):
+    """MXTPU_COMMSCHECK load-time hook shared by :class:`ServingEngine`
+    and :class:`~mxnet_tpu.serving.decode.DecodeLoop`: run the
+    communication lints over the freshly compiled (sharded) program set
+    (``obj.comms_report()``) and warn — or raise, under ``error`` — on any
+    unsuppressed finding. The ``comms-bound`` efficiency floor is NOT
+    applied here (min_eff=0): that roofline gates training scale-out,
+    while a model-parallel serving program deliberately trades predicted
+    efficiency for fitting the model at all."""
+    from ..engine import commscheck_mode
+    mode = commscheck_mode()
+    if mode == "off":
+        return
+    from .. import commscheck as _cc
+    # resolve the knob BEFORE the analyzer guard (same contract as the
+    # memory audit: operator errors propagate, analyzer failures skip)
+    repl = _cc.repl_bytes()
+    try:
+        findings = []
+        for rep in obj.comms_report().values():
+            findings += _cc.lint_report(rep, repl_threshold=repl,
+                                        min_eff=0.0)
+        bad = [f for f in findings if not f.suppressed]
+    except Exception as e:
+        logging.warning("%s(%s): comms audit could not run (%r) — "
+                        "skipped", who, obj.name, e)
+        return
+    if not bad:
+        return
+    msg = ("%s(%s): comms audit found %d problem(s) at load "
+           "(MXTPU_COMMSCHECK=%s):\n%s"
+           % (who, obj.name, len(bad), mode,
+              "\n".join(f.format() for f in bad)))
+    if mode == "error":
+        raise MXNetError(msg)
+    logging.warning(msg)
+
+
 def _audit_load_memory(obj, who):
     """MXTPU_MEMCHECK load-time hook shared by :class:`ServingEngine` and
     :class:`~mxnet_tpu.serving.decode.DecodeLoop`: run the memory lints
@@ -112,9 +175,16 @@ class ServingEngine(object):
     def __init__(self, symbol_json_or_file, param_file_or_dict, input_shapes,
                  buckets=None, output_names=None, allow_missing=False,
                  input_dtypes=None, executables=None, health=None,
-                 name=None):
+                 name=None, contexts=None):
         import jax
         from .. import tracecheck as _tc
+        #: model-axis mesh when this engine is bigger than one chip
+        #: (``contexts=``): params shard over 'model' per the
+        #: parallel.placement first-divisible-dim rule, batch inputs stay
+        #: replicated at the edges, and every bucket program compiles
+        #: partitioned — bitwise-identical to the single-chip engine
+        #: (the rule never splits a contraction dim)
+        self._mesh = _model_mesh(contexts, who="ServingEngine")
         self._symbol = _strip_loss_heads(load_symbol(symbol_json_or_file))
         if output_names:
             self._symbol = pick_partial_outputs(self._symbol, output_names)
@@ -147,14 +217,31 @@ class ServingEngine(object):
                                 aux_shapes))
         import jax.numpy as jnp
 
-        def as_dev(v, shape):
+        def place(arr, sharded):
+            """Model-mesh placement: params shard per the placement rule
+            (first divisible dim = the OUTPUT dim of an (out, in) weight,
+            so contraction dims never split and the partitioned forward
+            stays bitwise with single-chip); aux stats replicate."""
+            if self._mesh is None:
+                return arr
+            from ..parallel import placement as _pl
+            from ..parallel.mesh import AXIS_MODEL
+            P = jax.sharding.PartitionSpec
+            spec = None
+            if sharded:
+                spec = _pl.auto_spec(AXIS_MODEL, tuple(arr.shape),
+                                     self._mesh, prefer_first=True)
+            return jax.device_put(
+                arr, jax.sharding.NamedSharding(self._mesh, spec or P()))
+
+        def as_dev(v, shape, sharded=True):
             data = getattr(v, "data", v)  # NDArray or raw array
             arr = jnp.asarray(np.asarray(data))
             if tuple(arr.shape) != tuple(shape):
                 raise MXNetError(
                     "ServingEngine: parameter shape %s does not match the "
                     "graph's %s" % (tuple(arr.shape), tuple(shape)))
-            return arr
+            return place(arr, sharded)
 
         self._params = {}
         for n in self._symbol.list_arguments():
@@ -163,15 +250,40 @@ class ServingEngine(object):
             if n in arg_params:
                 self._params[n] = as_dev(arg_params[n], shape_of[n])
             else:  # allow_missing=True: deliberate zero-fill
-                self._params[n] = jnp.zeros(shape_of[n], np.float32)
+                self._params[n] = place(
+                    jnp.zeros(shape_of[n], np.float32), True)
         self._aux = {}
         for n in self._symbol.list_auxiliary_states():
             if n in aux_params:
-                self._aux[n] = as_dev(aux_params[n], aux_shape_of[n])
+                self._aux[n] = as_dev(aux_params[n], aux_shape_of[n],
+                                      sharded=False)
             else:
-                self._aux[n] = jnp.zeros(aux_shape_of[n], np.float32)
+                self._aux[n] = place(
+                    jnp.zeros(aux_shape_of[n], np.float32), False)
 
-        run, nodes = _build_graph_runner(self._symbol)
+        node_constraint = None
+        if self._mesh is not None:
+            # activations REPLICATED at every op edge, params sharded: each
+            # layer computes its output slice over the 'model' axis with
+            # FULL contractions (operand replicated, weight sharded on its
+            # output dim — the placement first-divisible-dim rule), then
+            # all-gathers the slice. That is Megatron column-parallel +
+            # gather, and it is what makes the sharded engine BITWISE
+            # identical to the single-chip one: no reduction ever spans
+            # shards, so float summation order never changes. Letting
+            # activations stay sharded between ops is faster on paper but
+            # lets GSPMD split a later contraction (or a softmax row
+            # reduction) into partial sums — a 1-ulp drift the parity
+            # acceptance test catches immediately.
+            _repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+
+            def node_constraint(node, outs, _repl=_repl):
+                return [jax.lax.with_sharding_constraint(o, _repl)
+                        for o in outs]
+
+        run, nodes = _build_graph_runner(self._symbol,
+                                         node_constraint=node_constraint)
         needs_rng = any((not n.is_variable) and n.op.needs_rng
                         for n in nodes)
         # eval-mode forward never consumes randomness, but ops declared
@@ -207,11 +319,14 @@ class ServingEngine(object):
             self._out_row_factor.append(
                 lead // self.buckets[0]
                 if lead and lead % self.buckets[0] == 0 else None)
-        # MXTPU_MEMCHECK: audit the freshly compiled bucket set's memory
-        # at LOAD time (docs/static_analysis.md "Memory lints") — a deploy
-        # that cannot fit its budget fails here, not at the first
-        # full-batch request
+        # MXTPU_MEMCHECK / MXTPU_COMMSCHECK: audit the freshly compiled
+        # bucket set's memory and (for sharded engines) collective
+        # inventory at LOAD time (docs/static_analysis.md) — a deploy that
+        # cannot fit its budget, or whose partitioning reshards a declared
+        # layout per request, fails here, not at the first full-batch
+        # request
         _audit_load_memory(self, "ServingEngine")
+        _audit_load_comms(self, "ServingEngine")
 
     # ------------------------------------------------------------------
     def _full_shapes(self, b):
@@ -221,18 +336,43 @@ class ServingEngine(object):
         import jax
 
         def sds(x):
+            # structs carry the REAL shardings so the AOT lowering (and
+            # the analyzers re-deriving the program from them) partition
+            # exactly like the live arrays — the commscheck struct_args
+            # contract
+            sh = getattr(x, "sharding", None)
+            if (self._mesh is not None
+                    and isinstance(sh, jax.sharding.NamedSharding)):
+                return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                            sharding=sh)
             return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
 
         params_s = {n: sds(v) for n, v in self._params.items()}
         aux_s = {n: sds(v) for n, v in self._aux.items()}
-        batch_s = {n: jax.ShapeDtypeStruct((b,) + self._input_shapes[n],
-                                           self._input_dtypes[n])
-                   for n in self._input_names}
+        repl = None
+        if self._mesh is not None:
+            repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+        batch_s = {}
+        for n in self._input_names:
+            shape = (b,) + self._input_shapes[n]
+            if repl is not None:
+                batch_s[n] = jax.ShapeDtypeStruct(
+                    shape, self._input_dtypes[n], sharding=repl)
+            else:
+                batch_s[n] = jax.ShapeDtypeStruct(shape,
+                                                  self._input_dtypes[n])
         return params_s, aux_s, batch_s
 
     @property
     def max_batch(self):
         return self.buckets[-1]
+
+    @property
+    def model_devices(self):
+        """Number of chips one replica of this engine spans (1 =
+        single-chip)."""
+        return 1 if self._mesh is None else int(self._mesh.devices.size)
 
     def bucket_for(self, n):
         """Smallest compiled bucket covering ``n`` examples."""
@@ -279,7 +419,16 @@ class ServingEngine(object):
             host = {k: np.concatenate(
                 [v, np.zeros((b - n,) + v.shape[1:], v.dtype)])
                 for k, v in host.items()}
-        batch = {k: jnp.asarray(v) for k, v in host.items()}
+        if self._mesh is None:
+            batch = {k: jnp.asarray(v) for k, v in host.items()}
+        else:
+            # activations replicated at the edges: the request lands whole
+            # on every model shard (AOT executables require inputs placed
+            # exactly as compiled)
+            import jax
+            repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+            batch = {k: jax.device_put(v, repl) for k, v in host.items()}
         outs = self._compiled[b](self._params, self._aux, batch)
         self.health.record_batch(n, b - n)
         res = []
@@ -296,7 +445,10 @@ class ServingEngine(object):
                 "input_shapes": {n: list(s)
                                  for n, s in self._input_shapes.items()},
                 "input_dtypes": {n: str(d)
-                                 for n, d in self._input_dtypes.items()}}
+                                 for n, d in self._input_dtypes.items()},
+                # a sharded executable only loads against the same mesh
+                # width; a mismatch falls back to fresh AOT compilation
+                "model_devices": self.model_devices}
 
     def export_compiled(self, path):
         """Serialize every bucket's compiled executable to ``path``
@@ -358,7 +510,30 @@ class ServingEngine(object):
                     "memory (%s) — skipped from the memory audit", b, e)
         return reports
 
-    def check(self, const_bytes=None, memory=False, budget=None):
+    def comms_report(self):
+        """Static collective-communication inventory of every compiled
+        bucket (docs/static_analysis.md "Communication lints"):
+        ``{program_name: CommsReport}`` from the ALREADY-compiled
+        executables — no recompile, nothing executes. Single-chip engines
+        report zero collectives; a model-axis-sharded engine's inventory
+        is the partitioning bill the deploy pays per request. Executables
+        that cannot surface HLO text are skipped with a warning."""
+        from .. import commscheck as _cc
+        reports = {}
+        for b, comp in sorted(self._compiled.items()):
+            name = "%s/bucket[b=%d]" % (self.name, b)
+            try:
+                reports[name] = _cc.analyze_compiled(comp, name,
+                                                     mesh=self._mesh)
+            except Exception as e:
+                logging.warning(
+                    "ServingEngine: bucket %d executable cannot report "
+                    "its collectives (%s) — skipped from the comms audit",
+                    b, e)
+        return reports
+
+    def check(self, const_bytes=None, memory=False, budget=None,
+              comms=False, min_eff=0.0):
         """Static-analyze this engine's registered bucket programs
         (docs/static_analysis.md); returns the findings.
 
@@ -366,7 +541,13 @@ class ServingEngine(object):
         compiled bucket (``hbm-budget``/``temp-blowup``) plus the
         ``resident-set`` lint over the whole bucket set — the jit/AOT
         cache keeps every bucket's executable reachable, so their
-        footprints co-reside."""
+        footprints co-reside.
+
+        ``comms=True`` adds the communication lints over every bucket's
+        collective inventory. ``min_eff`` defaults to 0 here (unlike the
+        training gate): the comms-bound roofline measures scale-out
+        efficiency, and a model-parallel serving program deliberately
+        trades it for fitting the model — pass a floor to opt in."""
         from .. import tracecheck as _tc
         findings = _tc.check_registered(const_bytes=const_bytes,
                                         match=self.name + "/")
@@ -378,4 +559,8 @@ class ServingEngine(object):
             findings += _mc.lint_resident_set(
                 reports.values(), "%s/resident-set" % self.name,
                 budget=budget)
+        if comms:
+            from .. import commscheck as _cc
+            for rep in self.comms_report().values():
+                findings += _cc.lint_report(rep, min_eff=min_eff)
         return findings

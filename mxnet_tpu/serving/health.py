@@ -29,6 +29,9 @@ class ServingHealth(object):
         self.decode_steps = 0      # continuous-batching decode iterations
         self.joined = 0            # sequences that entered a decode slot
         self.retired = 0           # sequences that left a decode slot
+        self.requeued = 0          # requests moved off a dead/draining
+        #                            replica back into the fleet queue
+        #                            (NOT failed — the no-silent-shed path)
         self.last_error = None
 
     def _bump(self, field, n=1, err=None):
@@ -71,6 +74,9 @@ class ServingHealth(object):
     def record_retire(self):
         self._bump("retired")
 
+    def record_requeued(self, n=1):
+        self._bump("requeued", n=n)
+
     def report(self):
         with self._lock:
             return {
@@ -79,7 +85,8 @@ class ServingHealth(object):
                 "expired": self.expired, "dropped": self.dropped,
                 "shed": self.shed, "errors": self.errors,
                 "decode_steps": self.decode_steps, "joined": self.joined,
-                "retired": self.retired, "last_error": self.last_error,
+                "retired": self.retired, "requeued": self.requeued,
+                "last_error": self.last_error,
             }
 
     def reset(self):
@@ -87,7 +94,7 @@ class ServingHealth(object):
             self.requests = self.batches = self.examples = 0
             self.padded = self.expired = self.dropped = 0
             self.shed = self.errors = self.decode_steps = 0
-            self.joined = self.retired = 0
+            self.joined = self.retired = self.requeued = 0
             self.last_error = None
 
     def __repr__(self):
