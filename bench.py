@@ -68,6 +68,23 @@ BENCH_FLEET_MODEL (mlp|lenet), BENCH_FLEET_BATCH_FRAC (0.25),
 BENCH_FLEET_DRAIN (1), BENCH_FLEET_DEADLINE_MS (20000), plus
 MXTPU_FLEET_* / MXTPU_SERVE_*.
 
+BENCH_ZOO_DISPATCH=1 switches to the zoo-dispatch mode (docs/perf.md
+"Packed accumulators"): the models whose metric class used to silently
+force k=1 — SSD's multi-head loc+cls under MultiBoxMetric and the
+transformer LM under Perplexity — run Module.fit(steps_per_dispatch=K)
+on the fused K-step scan at BENCH_ZD_DEVICES forced-host devices,
+measured k=1 vs k=K through the SAME fit loop plus a 1-device run for a
+dp-efficiency row; fails if any model falls back to k=1 or any
+tracecheck/memcheck finding appears over the new program set (the
+sharded programs are comms-audited at dispatch via MXTPU_COMMSCHECK=
+error). Knobs: BENCH_ZD_MODELS (ssd,transformer), BENCH_ZD_DEVICES (8),
+BENCH_ZD_BATCH (8*devices), BENCH_ZD_DISPATCHES (6), BENCH_ZD_IMAGE
+(64), BENCH_ZD_SEQ (32), BENCH_STEPS_PER_DISPATCH (4). NOTE on reading
+CPU numbers: XLA:CPU runs convolutions inside While/scan bodies ~3x
+slower than outside (matmuls unaffected), so conv models can read <1x
+on CPU hosts; the committed number's gate is engagement + parity +
+zero findings, the speedup story is the TPU round-6 table.
+
 BENCH_REAL_DATA=1 switches to the real-data input-tier gate (docs/perf.md
 "Device-fed input pipeline"): generate a real-JPEG RecordIO set, run an
 epoch of the SAME model/batch/K through the full
@@ -213,6 +230,157 @@ def host_overhead_main():
         "sweep": sweep,
     }
     print(json.dumps(out))
+
+
+def _zd_model(name, batch):
+    """(symbol, data dict, label dict, data/label names, metric) for the
+    zoo-dispatch bench — the models whose dispatch class used to force
+    k=1: SSD's multi-head loc+cls and the transformer LM under
+    Perplexity."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    rng = np.random.default_rng(0)
+    if name == "ssd":
+        image = int(os.environ.get("BENCH_ZD_IMAGE", "64"))
+        sym = models.get_symbol("ssd", num_classes=3, width=16)
+        X = rng.normal(size=(batch, 3, image, image)).astype(np.float32)
+        lab = rng.random((batch, 4, 5)).astype(np.float32)
+        lab[..., 0] = rng.integers(0, 3, (batch, 4))
+        x1 = np.minimum(lab[..., 1], lab[..., 3])
+        y1 = np.minimum(lab[..., 2], lab[..., 4])
+        lab[..., 3] = np.maximum(lab[..., 1], lab[..., 3]) + 0.05
+        lab[..., 4] = np.maximum(lab[..., 2], lab[..., 4]) + 0.05
+        lab[..., 1], lab[..., 2] = x1, y1
+        return (sym, {"data": X}, {"label": lab}, ("data",), ("label",),
+                mx.metric.MultiBoxMetric())
+    if name == "transformer":
+        seq = int(os.environ.get("BENCH_ZD_SEQ", "32"))
+        sym = models.get_symbol("transformer", vocab_size=64, embed=32,
+                                num_heads=4, num_layers=2, seq_len=seq)
+        X = rng.integers(0, 64, (batch, seq)).astype(np.float32)
+        y = rng.integers(0, 64, (batch, seq)).astype(np.float32)
+        return (sym, {"data": X}, {"softmax_label": y}, ("data",),
+                ("softmax_label",), mx.metric.Perplexity(ignore_label=None))
+    raise SystemExit("BENCH_ZD_MODELS entries must be ssd|transformer, "
+                     "got %r" % name)
+
+
+def zoo_dispatch_main():
+    """BENCH_ZOO_DISPATCH=1 (docs/perf.md "Packed accumulators"): the
+    scenario-diversity proof — the models whose metric class used to
+    silently force steps_per_dispatch=1 (SSD multi-head, transformer-LM
+    perplexity) run Module.fit on the fused K-step scan at
+    BENCH_ZD_DEVICES forced-host devices, measured k=1 vs k=K through
+    the SAME fit loop (epoch 1 compiles, epoch 2 is timed), plus the
+    k=K run at 1 device for a dp scaling-efficiency row. One JSON line;
+    fails if any model falls back to k=1 or any static finding appears
+    across the new program set (the dispatch-time commscheck hook is
+    armed in error mode for the sharded programs)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import tracecheck, memcheck
+
+    ndev = int(os.environ.get("BENCH_ZD_DEVICES", "8"))
+    k = int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "4"))
+    batch = int(os.environ.get("BENCH_ZD_BATCH", str(8 * max(1, ndev))))
+    dispatches = int(os.environ.get("BENCH_ZD_DISPATCHES", "6"))
+    model_names = [m for m in os.environ.get(
+        "BENCH_ZD_MODELS", "ssd,transformer").split(",") if m.strip()]
+    if len(jax.devices()) < ndev:
+        raise SystemExit(
+            "BENCH_ZD_DEVICES=%d but only %d device(s) visible — on CPU "
+            "raise with XLA_FLAGS=--xla_force_host_platform_device_count"
+            "=%d" % (ndev, len(jax.devices()), ndev))
+    # the sharded scans get comms-audited at first dispatch; min_eff=0
+    # because this gate checks the collective INVENTORY lints, not the
+    # training-scale-out roofline (mirroring the serving-tier audits)
+    os.environ.setdefault("MXTPU_COMMSCHECK", "error")
+    os.environ.setdefault("MXTPU_COMMSCHECK_MIN_EFF", "0")
+
+    def run_fit(name, spd, contexts, tag):
+        sym, data, label, dnames, lnames, metric = _zd_model(name, batch)
+        n = batch * spd * dispatches
+        reps = (n + batch - 1) // batch
+        Xr = {kk: np.concatenate([v] * reps)[:n] for kk, v in data.items()}
+        yr = {kk: np.concatenate([v] * reps)[:n] for kk, v in label.items()}
+        it = mx.io.NDArrayIter(Xr, yr, batch_size=batch)
+        mod = mx.mod.Module(sym, data_names=dnames, label_names=lnames,
+                            context=contexts)
+        mx.random.seed(0)
+        marks = {}
+
+        def epoch_cb(epoch, *_a):
+            marks[epoch] = time.perf_counter()
+
+        mod.fit(it, num_epoch=2, steps_per_dispatch=spd,
+                initializer=mx.initializer.Xavier(),
+                eval_metric=metric,
+                optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+                epoch_end_callback=epoch_cb)
+        wall = marks[1] - marks[0]
+        scan_engaged = (mod._fused is not None
+                        and any(key[1] == spd
+                                for key in mod._fused._jit_scan))
+        prefix = (mod._fused._watcher.name + "/"
+                  if mod._fused is not None and mod._fused._watcher
+                  else None)
+        return n / wall, scan_engaged, prefix, metric
+
+    ctx_n = [mx.Context("cpu" if jax.devices()[0].platform == "cpu"
+                        else "tpu", i) for i in range(ndev)]
+    ctx_1 = ctx_n[0]
+    rows = {}
+    prefixes = []
+    failed = []
+    for name in model_names:
+        ips_k1, _, _, _ = run_fit(name, 1, ctx_n, "k1")
+        ips_kk, engaged, prefix, metric = run_fit(name, k, ctx_n, "kk")
+        ips_1dev, _, _, _ = run_fit(name, k, ctx_1, "kk1dev")
+        if prefix:
+            prefixes.append(prefix)
+        if not engaged:
+            failed.append(name)
+        rows[name] = {
+            "k": k,
+            "img_per_sec_k1": round(ips_k1, 2),
+            "img_per_sec_k%d" % k: round(ips_kk, 2),
+            "dispatch_speedup": round(ips_kk / max(ips_k1, 1e-9), 3),
+            "dp_devices": ndev,
+            "img_per_sec_1dev": round(ips_1dev, 2),
+            "dp_efficiency": round(ips_kk / max(ips_1dev, 1e-9), 3),
+            "scan_engaged": engaged,
+            "metric": type(metric).__name__,
+        }
+    # the new program set must be lint-clean as a unit: tracecheck full
+    # lints + memcheck (incl. resident-set) over every program the fits
+    # registered; commscheck already gated each sharded dispatch (error
+    # mode raises inside fit)
+    findings = []
+    for p in prefixes:
+        findings += tracecheck.unsuppressed(
+            tracecheck.check_registered(match=p))
+    mem_findings, _reports = memcheck.check_registered(
+        match=tuple(prefixes), resident_name="zoo-dispatch")
+    findings += [f for f in mem_findings if not f.suppressed]
+    out = {
+        "metric": "zoo_dispatch_b%d_k%d_dp%d" % (batch, k, ndev),
+        "value": round(min(r["dispatch_speedup"] for r in rows.values()),
+                       3),
+        "unit": "min_dispatch_speedup_x",
+        "models": rows,
+        "findings": len(findings),
+        "retraces": tracecheck.retrace_count(),
+    }
+    print(json.dumps(out))
+    if failed:
+        raise SystemExit("BENCH_ZOO_DISPATCH gate: %s fell back to k=1 — "
+                         "the packed-accumulator path did not engage"
+                         % ", ".join(failed))
+    if findings:
+        for f in findings:
+            print(f.format(), file=sys.stderr)
+        raise SystemExit("BENCH_ZOO_DISPATCH gate: %d static finding(s) "
+                         "across the new program set" % len(findings))
 
 
 def _make_realdata_rec(path, n, size, quality, classes=8, seed=11):
@@ -1070,7 +1238,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_REAL_DATA", "").strip() not in ("", "0"):
+    if os.environ.get("BENCH_ZOO_DISPATCH", "").strip() not in ("", "0"):
+        zoo_dispatch_main()
+    elif os.environ.get("BENCH_REAL_DATA", "").strip() not in ("", "0"):
         realdata_main()
     elif os.environ.get("BENCH_FLEET", "").strip() not in ("", "0"):
         fleet_main()

@@ -28,6 +28,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # dp x tp resnet18, dp x sp ring transformer), per-dispatch collective
 # count/bytes vs the committed COMMSCHECK_baseline.json
 ./ci/commscheck.sh
+# zoo-dispatch gate (docs/perf.md "Packed accumulators"): every zoo
+# model must report a non-fallback K-step dispatch path (or a named,
+# documented reason) — precheck sweep over the whole zoo + real
+# steps_per_dispatch fits on the cheap models, tracecheck-clean
+./ci/zoo_dispatch.sh
 # serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
 # tracecheck findings on the serving program set (docs/serving.md)
 ./ci/serve.sh

@@ -1,0 +1,12 @@
+#!/bin/sh
+# CI gate: every zoo model reports a non-fallback K-step dispatch path
+# (docs/perf.md "Packed accumulators") — the packed-accumulator protocol's
+# no-silent-k=1 contract. Precheck sweep over the whole zoo (the exact
+# predicate fit consults, nothing executes) + real steps_per_dispatch=2
+# fits on the cheap models (mlp, lenet, ssd, transformer) that must land
+# a compiled scan and leave the program registry tracecheck-clean.
+set -e
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    python tools/zoo_dispatch_gate.py
+echo "zoo-dispatch PASS"

@@ -941,8 +941,7 @@ def check_zoo(names=None, k=2, guard=True, repl_threshold=None,
               min_eff=None, log=None):
     """Comms-audit the model zoo's step programs (same configs as
     ``tracecheck.ZOO``); returns ``(findings, reports)``."""
-    from . import models
-    from .train_step import TrainStep
+    from .tracecheck import zoo_train_step
     names = list(names) if names else sorted(ZOO)
     findings = []
     reports = {}
@@ -950,13 +949,11 @@ def check_zoo(names=None, k=2, guard=True, repl_threshold=None,
         if mname not in ZOO:
             raise MXNetError("commscheck: unknown zoo model %r (have %s)"
                              % (mname, ", ".join(sorted(ZOO))))
-        cfg = ZOO[mname]
         if log:
             log("commscheck: analyzing %s ..." % mname)
-        sym = models.get_symbol(mname, **cfg["kwargs"])
-        ts = TrainStep(sym, optimizer="sgd", learning_rate=0.1)
+        ts, data_shapes, label_shapes = zoo_train_step(mname)
         fs, reps = check_train_step(
-            ts, {"data": cfg["data"]}, {"softmax_label": cfg["label"]},
+            ts, data_shapes, label_shapes,
             k=k, guard=guard, name=mname, repl_threshold=repl_threshold,
             min_eff=min_eff)
         findings += fs

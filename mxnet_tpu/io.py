@@ -412,19 +412,30 @@ class PrefetchingIter(DataIter):
 class SuperDataBatch(DataBatch):
     """K stacked mini-batches: every array carries a leading (k,) step axis.
 
-    ``num_steps`` may be smaller than the configured K for the epoch tail;
-    consumers that compiled for a fixed K should route such a tail through
+    ``num_steps`` may be smaller than the configured K for the epoch tail
+    (or for a bucket-run cut — see :class:`SuperBatchIter`); consumers
+    that compiled for a fixed K should route such a tail through
     :meth:`unstack` (per-step views) instead of compiling a second scan.
+
+    ``bucket_key`` (variable-length/bucketed iterators) names the bucket
+    every stacked step shares; ``step_provide_data``/``step_provide_label``
+    are the UNstacked per-step descriptors, so an unstacked view can
+    re-bind a bucketed executor (BucketingModule.switch_bucket needs
+    them).
     """
 
     def __init__(self, data, label=None, pads=None, num_steps=1,
-                 provide_data=None, provide_label=None):
+                 provide_data=None, provide_label=None, bucket_key=None,
+                 step_provide_data=None, step_provide_label=None):
         pads = list(pads) if pads is not None else [0] * num_steps
         super().__init__(data, label=label, pad=pads[-1] if pads else 0,
+                         bucket_key=bucket_key,
                          provide_data=provide_data,
                          provide_label=provide_label)
         self.num_steps = num_steps
         self.pads = pads
+        self.step_provide_data = step_provide_data
+        self.step_provide_label = step_provide_label
 
     def unstack(self):
         """Per-step DataBatch views (on-device slices along the step axis)."""
@@ -433,7 +444,10 @@ class SuperDataBatch(DataBatch):
             out.append(DataBatch(
                 data=[a[i] for a in self.data],
                 label=[a[i] for a in (self.label or [])],
-                pad=self.pads[i] if i < len(self.pads) else 0))
+                pad=self.pads[i] if i < len(self.pads) else 0,
+                bucket_key=self.bucket_key,
+                provide_data=self.step_provide_data,
+                provide_label=self.step_provide_label))
         return out
 
 
@@ -494,6 +508,7 @@ class SuperBatchIter(DataIter):
         self._thread = None
         self._stop = None
         self._done = False
+        self._held = None  # first batch of the NEXT bucket run (bucketed)
         if prefetch:
             self._start_producer()
 
@@ -532,16 +547,37 @@ class SuperBatchIter(DataIter):
                           self.data_health)
 
     def _pull_group(self):
-        group = []
-        for _ in range(self.k):
-            try:
-                group.append(self._pull_one())
-            except StopIteration:
-                break
-        if not group or (len(group) < self.k
-                         and self.last_group_handle == "discard"):
-            return None
-        return group
+        """Up to K consecutive batches — cut EARLY when the bucket key
+        changes (variable-length/bucketed iterators): a stacked superbatch
+        must be shape-homogeneous, so a bucket switch emits the run
+        collected so far as a partial group and holds the first
+        differing batch for the next group. Batch order is preserved, so
+        bucketed K-step training stays step-for-step identical to k=1."""
+        while True:
+            group = [self._held] if self._held is not None else []
+            self._held = None
+            while len(group) < self.k:
+                try:
+                    b = self._pull_one()
+                except StopIteration:
+                    break
+                if group and (getattr(b, "bucket_key", None)
+                              != getattr(group[0], "bucket_key", None)):
+                    self._held = b
+                    break
+                group.append(b)
+            if not group:
+                return None
+            if len(group) < self.k and self.last_group_handle == "discard":
+                if self._held is not None:
+                    # a bucket cut, NOT the epoch tail: drop this short
+                    # run per the discard contract but KEEP iterating —
+                    # returning None here would silently end the epoch
+                    # with the held batch (and everything after it)
+                    # untrained
+                    continue
+                return None
+            return group
 
     def _note_stage(self, stage, seconds, n=1):
         """Per-stage timing hook (stack / h2d), a no-op here; the input
@@ -599,10 +635,21 @@ class SuperBatchIter(DataIter):
                 for i in range(n_data)]
         label = [self._stack([b.label[i] for b in group])
                  for i in range(n_label)]
+        # bucketed batches carry their own per-bucket descriptors: the
+        # stacked descs must come from the GROUP's shapes, not the base
+        # iterator's default-bucket ones
+        step_pd = group[0].provide_data
+        step_pl = group[0].provide_label
+        provide_data = (self._stacked_descs(step_pd)
+                        if step_pd is not None else self.provide_data)
+        provide_label = (self._stacked_descs(step_pl)
+                         if step_pl is not None else self.provide_label)
         return SuperDataBatch(
             data=data, label=label, pads=[b.pad or 0 for b in group],
-            num_steps=len(group), provide_data=self.provide_data,
-            provide_label=self.provide_label)
+            num_steps=len(group), provide_data=provide_data,
+            provide_label=provide_label,
+            bucket_key=getattr(group[0], "bucket_key", None),
+            step_provide_data=step_pd, step_provide_label=step_pl)
 
     # -- producer thread -----------------------------------------------
     def _start_producer(self):
@@ -688,6 +735,7 @@ class SuperBatchIter(DataIter):
             self._shutdown_producer()
         self.base.reset()
         self._done = False
+        self._held = None
         if self._prefetch:
             self._start_producer()
 
@@ -701,6 +749,7 @@ class SuperBatchIter(DataIter):
             self._shutdown_producer()
         self._queue = None
         self._done = True
+        self._held = None
 
     def next(self):
         if self._done:

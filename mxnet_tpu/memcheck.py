@@ -73,7 +73,8 @@ from .tracecheck import (Finding, MEM_LINTS, _is_suppressed,
 __all__ = [
     "MemoryReport", "analyze", "analyze_compiled", "lint_report",
     "lint_resident_set", "resident_bytes", "check_program",
-    "check_train_step", "check_zoo", "compare_baseline", "write_baseline",
+    "check_registered", "check_train_step", "check_zoo",
+    "compare_baseline", "write_baseline",
     "device_budget", "budget_bytes", "temp_multiple", "tolerance", "main",
     "MEM_LINTS",
 ]
@@ -577,6 +578,48 @@ def check_program(fn, args=(), kwargs=None, donate_argnums=(), name=None,
     return lint_report(report, budget=budget, temp_mult=temp_mult), report
 
 
+def check_registered(match=None, budget=None, temp_mult=None,
+                     resident_name=None):
+    """Memory-audit live programs from the tracecheck registry whose name
+    contains ``match`` (a string, or a tuple — contains ANY): per-program
+    lints plus ONE ``resident-set`` lint over the whole matched set. This
+    is the bucketed-cache audit (``BucketingModule.check(memory=True)``,
+    docs/perf.md "Packed accumulators"): every bucket shape's compiled
+    scan stays reachable in its jit cache, so the set's co-resident
+    footprint — max(args+out) + sum(temps) — is what the budget must
+    cover. Returns ``(findings, reports)``."""
+    from .tracecheck import registered_programs
+    if match is None:
+        matches = None                  # audit EVERY registered program
+    else:
+        matches = (match,) if isinstance(match, str) else tuple(match)
+        if not matches:
+            # an explicitly EMPTY prefix set audits nothing: a
+            # BucketingModule that never dispatched must not sweep (and
+            # attribute a resident-set over) unrelated programs
+            return [], {}
+    findings = []
+    reports = {}
+    for rec in registered_programs():
+        if matches is not None and not any(m in rec.name
+                                           for m in matches):
+            continue
+        fn = rec.fn_ref()
+        if fn is None:
+            continue
+        fs, rep = check_program(fn, rec.arg_structs,
+                                donate_argnums=rec.donate_argnums,
+                                name=rec.name, budget=budget,
+                                temp_mult=temp_mult)
+        findings += fs
+        reports[rec.name] = rep
+    findings += lint_resident_set(
+        reports.values(),
+        "%s/resident-set" % (resident_name or "registered"),
+        budget=budget)
+    return findings, reports
+
+
 # ---------------------------------------------------------------------------
 # TrainStep / zoo auditing (mirrors tracecheck.check_train_step)
 # ---------------------------------------------------------------------------
@@ -611,8 +654,7 @@ def check_zoo(names=None, k=2, guard=True, budget=None, temp_mult=None,
               log=None):
     """Memory-audit the model zoo's step programs (same configs as
     ``tracecheck.ZOO``); returns ``(findings, reports)``."""
-    from . import models
-    from .train_step import TrainStep
+    from .tracecheck import zoo_train_step
     names = list(names) if names else sorted(ZOO)
     findings = []
     reports = {}
@@ -620,13 +662,11 @@ def check_zoo(names=None, k=2, guard=True, budget=None, temp_mult=None,
         if mname not in ZOO:
             raise MXNetError("memcheck: unknown zoo model %r (have %s)"
                              % (mname, ", ".join(sorted(ZOO))))
-        cfg = ZOO[mname]
         if log:
             log("memcheck: analyzing %s ..." % mname)
-        sym = models.get_symbol(mname, **cfg["kwargs"])
-        ts = TrainStep(sym, optimizer="sgd", learning_rate=0.1)
+        ts, data_shapes, label_shapes = zoo_train_step(mname)
         fs, reps = check_train_step(
-            ts, {"data": cfg["data"]}, {"softmax_label": cfg["label"]},
+            ts, data_shapes, label_shapes,
             k=k, guard=guard, name=mname, budget=budget,
             temp_mult=temp_mult)
         findings += fs

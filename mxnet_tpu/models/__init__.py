@@ -13,6 +13,7 @@ from .alexnet import get_symbol as alexnet
 from .vgg import get_symbol as vgg
 from .inception_bn import get_symbol as inception_bn
 from .transformer import get_symbol as transformer
+from .ssd import get_symbol_train as ssd_train
 
 _FACTORIES = {
     "transformer": transformer,
@@ -22,6 +23,9 @@ _FACTORIES = {
     "alexnet": alexnet,
     "vgg": vgg,
     "inception-bn": inception_bn,
+    # the TRAIN symbol (MultiBoxTarget matching + loss heads): the zoo
+    # audits and the dispatch gate exercise training programs
+    "ssd": ssd_train,
 }
 
 

@@ -365,24 +365,18 @@ class BaseModule(object):
             elif not hasattr(train_data, "superbatch"):
                 reason = "train_data is not a DataIter (no superbatch mode)"
             else:
-                # module-level eligibility (optimizer/grad_req/dist/head
-                # shape) is knowable NOW — checking here instead of per
-                # dispatch avoids silently paying superbatch stacking for an
-                # epoch the per-step path ends up training anyway
+                # module-level eligibility (optimizer/grad_req/dist) AND
+                # the metric's packed-accumulator layout (docs/perf.md
+                # "Packed accumulators") are knowable NOW — checking here
+                # instead of per dispatch avoids silently paying
+                # superbatch stacking for an epoch the per-step path ends
+                # up training anyway, and guarantees every fallback warns
+                # with a reason that names WHY (metric, shapes, config)
                 can = getattr(self, "_can_bulk_dispatch", None)
                 if can is not None:
-                    ok, why = can()
+                    ok, why = can(eval_metric)
                     if not ok:
                         reason = why
-            if reason is None and not _metric.supports_device_sums(
-                    eval_metric):
-                # checked LAST: supports_device_sums raises for near-miss
-                # metrics (CrossEntropy eps), and that rejection must only
-                # fire when the run would otherwise take the device-sum
-                # path — an already-ineligible config falls back per-step,
-                # where the host metric honors any eps
-                reason = ("metric %r cannot consume device-side K-step sums"
-                          % eval_metric.name)
             if reason is not None:
                 self.logger.warning(
                     "steps_per_dispatch=%d unavailable (%s); training "

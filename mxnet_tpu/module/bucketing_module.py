@@ -33,12 +33,34 @@ class BucketingModule(BaseModule):
         self._curr_module = None
         self._curr_bucket_key = None
         self._params_dirty = False
+        # bucketed fused fast path (docs/perf.md "Packed accumulators"):
+        # ONE donated state tree shared by per-bucket compiled K-step
+        # scans — every bucket shape gets its own TrainStep (and jit
+        # cache entry) over the SAME parameters, so variable-length
+        # training rides the fused dispatch instead of falling back to
+        # per-step executors
+        self._bucket_fused = {}      # bucket_key -> TrainStep
+        self._bucket_specs = {}      # bucket_key -> DeviceSumSpec | None
+        self._bucket_warned = set()  # bucket_key fallbacks already named
+        self._fused_state = None
+        self._fused_outputs = None
+        self._fused_dirty = False
+        self._fused_params_stale = False
+        self._fused_metric = None    # metric fit() resolved specs for
+        self._fused_host_step = 0
 
     def _reset_bind(self):
         self.binded = False
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
+        self._bucket_fused = {}
+        self._bucket_specs = {}
+        self._bucket_warned = set()
+        self._fused_state = None
+        self._fused_outputs = None
+        self._fused_dirty = False
+        self._fused_params_stale = False
 
     @property
     def data_names(self):
@@ -82,6 +104,7 @@ class BucketingModule(BaseModule):
 
     def get_params(self):
         assert self.params_initialized
+        self._sync_fused_to_executor()
         self._curr_module._params_dirty = self._params_dirty
         params = self._curr_module.get_params()
         self._params_dirty = False
@@ -99,6 +122,10 @@ class BucketingModule(BaseModule):
                                       force_init=force_init)
         self._params_dirty = False
         self.params_initialized = True
+        # executor arrays are now authoritative: the shared fused state
+        # must re-seed from them, never write back over them
+        self._fused_params_stale = True
+        self._fused_dirty = False
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
@@ -168,8 +195,332 @@ class BucketingModule(BaseModule):
                 mod.borrow_optimizer(self._curr_module)
         self.optimizer_initialized = True
 
+    # -- bucketed fused K-step dispatch (docs/perf.md "Packed
+    # -- accumulators": bucketed-shape jit-cache handling) ---------------
+    @property
+    def _base_module(self):
+        return self._buckets[self._default_bucket_key]
+
+    def _can_bulk_dispatch(self, eval_metric=None):
+        """fit()'s precheck: whether this bucketed module can ride the
+        fused K-step scan — one compiled program per bucket shape over ONE
+        shared donated state tree. With ``eval_metric`` the metric's
+        packed-accumulator spec must resolve for the DEFAULT bucket's
+        shapes (per-bucket specs resolve lazily at first dispatch of each
+        bucket); the metric is stashed so dispatches can resolve them."""
+        base = self._base_module
+        opt = base._optimizer
+        if not getattr(opt, "fused_supported", False):
+            return (False, "optimizer %r has no fused update"
+                    % type(opt).__name__)
+        if base._is_dist_kvstore():
+            return (False, "dist kvstore keeps per-step dispatch "
+                    "(per-step push/pull sync is the contract)")
+        if base._monitor_installed:
+            return (False, "a monitor needs per-step executor access")
+        if self.inputs_need_grad or self._state_names:
+            return (False, "inputs_need_grad/state_names need the "
+                    "per-step executor path")
+        eg = base._exec_group
+        if eg._mesh is not None:
+            return (False, "bucketed dispatch is single-device (one "
+                    "fused program per bucket shape; no data mesh yet)")
+        for n in eg.param_names:
+            if eg.grad_req.get(n, "null") not in ("write", "null"):
+                return (False, "grad_req %r needs the per-step executor "
+                        "path" % eg.grad_req.get(n))
+        if eval_metric is not None:
+            spec = base._device_sum_spec(eval_metric)
+            if spec is None:
+                return (False, "metric %r declares no device-sum layout "
+                        "for the default bucket's shapes — it updates "
+                        "per-step on host"
+                        % getattr(eval_metric, "name", eval_metric))
+            self._fused_metric = eval_metric
+            self._bucket_specs = {}
+        return True, None
+
+    def _can_guard(self):
+        return (False, "bucketed dispatch trains unguarded (per-bucket "
+                "fused programs carry no guard sentinels yet)")
+
+    def _get_bucket_step(self, bucket_key):
+        """The bucket's compiled TrainStep, built lazily from its symbol —
+        NO executor is bound for buckets that only ever train fused. All
+        bucket TrainSteps share the module's ONE optimizer instance, so
+        the lr-schedule clock advances once across every bucket."""
+        ts = self._bucket_fused.get(bucket_key)
+        if ts is not None:
+            return ts
+        from ..train_step import TrainStep
+        base = self._base_module
+        symbol, data_names, label_names = self._call_sym_gen(bucket_key)
+        eg = base._exec_group
+        frozen = [n for n in eg.param_names
+                  if eg.grad_req.get(n, "null") == "null"]
+        ts = TrainStep(symbol, data_names=list(data_names),
+                       label_names=list(label_names),
+                       optimizer=base._optimizer,
+                       frozen_param_names=frozen)
+        self._bucket_fused[bucket_key] = ts
+        return ts
+
+    def _get_bucket_spec(self, bucket_key, ts, super_batch):
+        """The stashed metric's packed-accumulator spec resolved against
+        THIS bucket's shapes (cached per bucket — the slot layout is
+        metric-determined and identical across buckets, only the traced
+        shapes differ)."""
+        if bucket_key in self._bucket_specs:
+            return self._bucket_specs[bucket_key]
+        from .. import metric as _metric
+        spec = None
+        if self._fused_metric is not None:
+            shapes = {}
+            lshapes = []
+            pd = super_batch.step_provide_data
+            pl = super_batch.step_provide_label
+            if pd is None:
+                # no per-bucket descriptors: derive from the stacked arrays
+                pd = list(zip(ts.data_names,
+                              [tuple(v.shape[1:])
+                               for v in super_batch.data]))
+                pl = list(zip(ts.label_names,
+                              [tuple(v.shape[1:])
+                               for v in (super_batch.label or [])]))
+            for d in pd:
+                name, shape = ((d.name, d.shape) if hasattr(d, "name")
+                               else (d[0], d[1]))
+                shapes[name] = tuple(shape)
+            for l in (pl or []):
+                name, shape = ((l.name, l.shape) if hasattr(l, "name")
+                               else (l[0], l[1]))
+                shapes[name] = tuple(shape)
+                lshapes.append(tuple(shape))
+            try:
+                _, out_shapes, _ = ts.symbol.infer_shape(**shapes)
+                spec = _metric.device_sum_spec(self._fused_metric,
+                                               out_shapes, lshapes)
+            except Exception:
+                spec = None
+        self._bucket_specs[bucket_key] = spec
+        return spec
+
+    def _warn_bucket_fallback(self, bucket_key, why):
+        if bucket_key in self._bucket_warned:
+            return
+        self._bucket_warned.add(bucket_key)
+        self.logger.warning(
+            "bucketed dispatch: bucket %r falls back to per-step "
+            "training (%s)", bucket_key, why)
+
+    def _seed_fused_state(self, ts):
+        """The ONE shared state tree, seeded from the default bucket's
+        executor arrays + updater states (copies — the first dispatch
+        donates the buffers). The step clock continues from the host-side
+        mirror so noise streams survive a re-seed."""
+        import jax.numpy as jnp
+        from .module import _seed_opt_state
+        base = self._base_module
+        ex = base._exec_group.executor
+        params = {n: base._jnp_copy(ex.arg_dict[n].data)
+                  for n in ts.param_names}
+        aux = ts.cast_stats({n: base._jnp_copy(ex.aux_dict[n].data)
+                             for n in ts.aux_names})
+        opt = _seed_opt_state(ts, params, base._optimizer,
+                              base._resolve_updater(),
+                              base._exec_group.param_names)
+        state = {"params": params, "aux": aux, "opt": opt,
+                 "step": jnp.full((), self._fused_host_step, jnp.int32)}
+        # COMMIT every leaf to the BOUND context's device: the per-bucket
+        # scan outputs are committed arrays, and an uncommitted seed
+        # state would give the first dispatch after every (re-)seed a
+        # different jit cache key than steady state — one spurious
+        # compile per bucket per seed (measured; the bucketed-cache
+        # assert_no_retrace pin catches it). The bound device, not
+        # devices()[0]: a module bound on a non-zero device must not
+        # migrate its training onto device 0
+        import jax
+        ctx = (base._context[0] if getattr(base, "_context", None)
+               else None)
+        dev = ctx.to_device() if ctx is not None else jax.devices()[0]
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, dev), state)
+
+    def _ensure_fused_state(self, ts):
+        """Param-set compatibility BEFORE seeding, and the seed always
+        from the DEFAULT bucket's TrainStep: a bucket symbol with an
+        extra/missing parameter must warn-and-fall-back (the caller's
+        contract), never KeyError mid-seed or skew the shared tree onto
+        its own param set."""
+        base_ts = self._get_bucket_step(self._default_bucket_key)
+        if set(ts.param_names) != set(base_ts.param_names):
+            return False
+        if self._fused_state is None or self._fused_params_stale:
+            self._fused_state = self._seed_fused_state(base_ts)
+            self._fused_params_stale = False
+        return True
+
+    def _dispatch_fused_steps(self, super_batch, guard=None):
+        """fit()'s bucketed K-step fast path: one donated ``lax.scan``
+        through THIS bucket's compiled program over the shared state tree
+        (the jit cache plays the reference's shared-storage re-bind role
+        one level up — per bucket SHAPE, not per bucket executor).
+        Returns None when this superbatch must train per-step."""
+        if guard is not None:
+            return None
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            return None
+        ok, _why = self._can_bulk_dispatch()
+        if not ok:
+            return None
+        key = super_batch.bucket_key
+        if key is None:
+            key = self._default_bucket_key
+        ts = self._get_bucket_step(key)
+        if not self._ensure_fused_state(ts):
+            self._warn_bucket_fallback(
+                key, "its symbol's parameter set differs from the shared "
+                "state tree")
+            return None
+        spec = self._get_bucket_spec(key, ts, super_batch)
+        if self._fused_metric is not None and spec is None:
+            self._warn_bucket_fallback(
+                key, "metric %r declares no device-sum layout for this "
+                "bucket's shapes"
+                % getattr(self._fused_metric, "name", self._fused_metric))
+            return None
+        feed = {}
+        for name, v in zip(ts.data_names, super_batch.data):
+            feed[name] = v
+        for name, v in zip(ts.label_names, super_batch.label or []):
+            feed[name] = v
+        feed = ts.shard_superbatch(feed)
+        self._fused_state, sums = ts.run_steps(self._fused_state, feed,
+                                               metric_spec=spec)
+        self._fused_outputs = None
+        self._fused_dirty = True
+        self._params_dirty = True
+        self._fused_host_step += super_batch.num_steps
+        return sums
+
+    def _try_fused_fit_step(self, data_batch, guard=None):
+        """fit()'s per-step path for bucket-run tails: the bucket's fused
+        single-step program over the SAME shared state — so a superbatch
+        cut short by a bucket switch never detours through the executor
+        (whose optimizer state would then diverge from the donated
+        tree)."""
+        if guard is not None:
+            return False
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            return False
+        ok, _why = self._can_bulk_dispatch()
+        if not ok:
+            return False
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:
+            key = self._default_bucket_key
+        ts = self._get_bucket_step(key)
+        if not self._ensure_fused_state(ts):
+            return False
+        import jax.numpy as jnp
+        from ..ndarray import NDArray
+
+        def to_jnp(v):
+            return v.data if isinstance(v, NDArray) else jnp.asarray(v)
+
+        feed = {}
+        for name, v in zip(ts.data_names, data_batch.data):
+            feed[name] = to_jnp(v)
+        for name, v in zip(ts.label_names, data_batch.label or []):
+            feed[name] = to_jnp(v)
+        self._fused_state, outs = ts.step(self._fused_state, feed)
+        self._fused_outputs = [NDArray(o) for o in outs]
+        self._fused_dirty = True
+        self._params_dirty = True
+        self._fused_host_step += 1
+        return True
+
+    def _sync_fused_to_executor(self):
+        """Write the shared fused params/aux back into the default
+        bucket's executor arrays — which every bucket executor ALIASES
+        (the shared-pool bind), so one write covers the whole module."""
+        if not self._fused_dirty or self._fused_state is None:
+            return
+        base = self._base_module
+        ex = base._exec_group.executor
+        for n, v in self._fused_state["params"].items():
+            ex.arg_dict[n]._set_data(base._jnp_copy(v))
+        for n, v in self._fused_state["aux"].items():
+            v = base._jnp_copy(v)
+            tgt = ex.aux_dict[n].data.dtype
+            if v.dtype != tgt:
+                v = v.astype(tgt)
+            ex.aux_dict[n]._set_data(v)
+        self._fused_dirty = False
+
+    def _sync_fused_opt_states(self):
+        """Mirror the shared fused optimizer state into the updater's
+        index-keyed dict so ``save_optimizer_states`` (and an imperative
+        ``update()`` after fused training) see the trained moments."""
+        if self._fused_state is None:
+            return
+        base = self._base_module
+        updater = base._resolve_updater()
+        if updater is None:
+            return
+        from ..ndarray import NDArray
+
+        def to_nd(x):
+            if x is None:
+                return None
+            if isinstance(x, tuple):
+                return tuple(to_nd(i) for i in x)
+            v = base._jnp_copy(x)
+            if str(v.dtype) == "bfloat16":
+                import jax.numpy as jnp
+                v = v.astype(jnp.float32)
+            return NDArray(v)
+
+        idx_of = {n: i for i, n in enumerate(base._exec_group.param_names)}
+        for n, st in self._fused_state["opt"].items():
+            if n in idx_of:
+                updater.states[idx_of[n]] = to_nd(st)
+
+    def save_optimizer_states(self, fname):
+        self._sync_fused_opt_states()
+        return self._curr_module.save_optimizer_states(fname)
+
+    def check(self, memory=False, budget=None, temp_mult=None):
+        """Static audit of the fused bucket-program cache AS A UNIT
+        (docs/static_analysis.md): tracecheck lints per registered bucket
+        program, plus (``memory=True``) the memcheck per-program lints
+        and ONE ``resident-set`` finding over every bucket's compiled
+        scan — the jit caches keep all of them reachable at once, so the
+        cache's co-resident footprint is what the budget must cover."""
+        from .. import tracecheck as _tc
+        prefixes = [ts._watcher.name + "/"
+                    for ts in self._bucket_fused.values()
+                    if ts._watcher is not None]
+        findings = []
+        for p in prefixes:
+            findings += _tc.check_registered(match=p)
+        if memory:
+            from .. import memcheck as _mc
+            fs, _reports = _mc.check_registered(
+                match=tuple(prefixes), budget=budget, temp_mult=temp_mult,
+                resident_name="BucketingModule(%s)"
+                % self._default_bucket_key)
+            findings += fs
+        return findings
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        # an executor forward must see the fused-trained params (shared
+        # arrays across buckets — one sync covers all executors)
+        self._sync_fused_to_executor()
+        self._fused_outputs = None
         self.switch_bucket(data_batch.bucket_key,
                            data_batch.provide_data,
                            data_batch.provide_label)
@@ -183,10 +534,18 @@ class BucketingModule(BaseModule):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        if self._fused_state is not None and not self._fused_params_stale:
+            # imperative updates land in the executor arrays + updater
+            # states: hand them the fused moments first, and re-seed the
+            # shared state tree before the next fused dispatch
+            self._sync_fused_opt_states()
+            self._fused_params_stale = True
         self._curr_module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_outputs is not None:
+            return list(self._fused_outputs)
         return self._curr_module.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -196,6 +555,9 @@ class BucketingModule(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
+        if self._fused_outputs is not None:
+            eval_metric.update(labels, self._fused_outputs)
+            return
         self._curr_module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):

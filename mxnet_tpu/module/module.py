@@ -24,6 +24,34 @@ from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
 
 
+def _seed_opt_state(ts, params, optimizer, updater, exec_param_names):
+    """Optimizer state for a fused state tree, seeded from preloaded
+    updater states when present (load_optimizer_states round-trip) —
+    ONE recipe shared by Module._fused_opt_state and
+    BucketingModule._seed_fused_state, so the two fused paths can never
+    drift on how moments are imported or bf16-cast."""
+    states = dict(getattr(updater, "states", None) or {})
+    idx_of = {n: i for i, n in enumerate(exec_param_names)}
+
+    def to_jnp(x):
+        if x is None:
+            return None
+        if isinstance(x, tuple):
+            return tuple(to_jnp(i) for i in x)
+        return x.data if hasattr(x, "data") else x
+
+    out = {}
+    for n, v in params.items():
+        if n in ts.frozen_param_names:
+            continue
+        idx = idx_of.get(n)
+        if idx is not None and idx in states:
+            out[n] = to_jnp(states[idx])
+        else:
+            out[n] = optimizer.create_fused_state(v)
+    return ts.cast_opt_state(out)
+
+
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
@@ -97,6 +125,10 @@ class Module(BaseModule):
         self._fused_dirty = False
         self._fused_params_stale = False
         self._fused_metrics_ok = False
+        # the eval metric's resolved packed-accumulator spec
+        # (docs/perf.md "Packed accumulators"), stashed by
+        # _can_bulk_dispatch(eval_metric) and consumed per dispatch
+        self._fused_metric_spec = None
         self._monitor_installed = False
         # checkpoint resume: the update-count the fused step clock (and lr
         # schedule) continues from (set via _restore_trainer_clock)
@@ -516,36 +548,61 @@ class Module(BaseModule):
         self._fused_params_stale = False
         self._fused_metrics_ok = self._infer_fused_metrics_ok()
 
+    def _bound_shapes(self):
+        """(input-shape dict, label shapes, output shapes) from the bound
+        data/label descriptors — what the packed-accumulator protocol
+        resolves metric specs against."""
+        shapes = {}
+        for d in (self._data_shapes or []):
+            name, shape = ((d.name, d.shape) if hasattr(d, "name")
+                           else (d[0], d[1]))
+            shapes[name] = shape
+        lshapes = []
+        for l in (self._label_shapes or []):
+            name, shape = ((l.name, l.shape) if hasattr(l, "name")
+                           else (l[0], l[1]))
+            shapes[name] = shape
+            lshapes.append(shape)
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return shapes, lshapes, out_shapes
+
     def _infer_fused_metrics_ok(self):
-        """The K-step scan's device metric sums are only well-defined for a
-        single (rank-2 output, rank-1 label) classification head — the
-        in-scan accumulator would double-count multi-head nets and report
-        zeros for non-matching shapes, where per-step host metrics see the
-        real outputs (run_steps pairs outputs/labels positionally)."""
+        """Whether the DEFAULT packed layout (in-scan CE loss + top-1
+        correct) is well-defined for this module: a single (rank-2 output,
+        rank-1 label) classification head. The guard's loss observation
+        and spec-less ``run_steps`` callers rely on it; metric-declared
+        layouts (:meth:`_device_sum_spec`) cover everything else."""
         try:
-            shapes = {}
-            for d in (self._data_shapes or []):
-                name, shape = ((d.name, d.shape) if hasattr(d, "name")
-                               else (d[0], d[1]))
-                shapes[name] = shape
-            lshapes = []
-            for l in (self._label_shapes or []):
-                name, shape = ((l.name, l.shape) if hasattr(l, "name")
-                               else (l[0], l[1]))
-                shapes[name] = shape
-                lshapes.append(shape)
-            _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+            _, lshapes, out_shapes = self._bound_shapes()
             return (len(out_shapes) == 1 and len(lshapes) == 1
                     and len(out_shapes[0]) == 2 and len(lshapes[0]) == 1
                     and out_shapes[0][0] == lshapes[0][0])
         except Exception:
             return False
 
-    def _can_bulk_dispatch(self):
+    def _device_sum_spec(self, metric):
+        """Resolve ``metric``'s packed-accumulator layout
+        (:func:`mxnet_tpu.metric.device_sum_spec`) against this module's
+        bound output/label shapes; None when the metric declares none for
+        these shapes."""
+        from .. import metric as _metric
+        try:
+            _, lshapes, out_shapes = self._bound_shapes()
+            return _metric.device_sum_spec(metric, out_shapes, lshapes)
+        except Exception:
+            return None
+
+    def _can_bulk_dispatch(self, eval_metric=None):
         """fit()'s precheck half of :meth:`_dispatch_fused_steps`: called
         after init_optimizer so steps_per_dispatch>1 warns and skips the
         superbatch wrapper up front instead of silently paying K-batch
-        stacking for dispatches the per-step path ends up training."""
+        stacking for dispatches the per-step path ends up training.
+
+        With ``eval_metric`` the metric's packed-accumulator spec is
+        resolved against the bound shapes and STASHED on the module
+        (``_fused_metric_spec``) for :meth:`_dispatch_fused_steps`;
+        without one (the guard precheck) the DEFAULT layout's
+        single-head shape requirement applies."""
         if not self._fused_eligible():
             return (False, "module configuration needs the per-step "
                     "executor path (monitor/grad_req/unfused optimizer/"
@@ -553,9 +610,25 @@ class Module(BaseModule):
         if self._is_dist_kvstore():
             return (False, "dist kvstore keeps per-step dispatch "
                     "(per-step push/pull sync is the contract)")
-        if not self._infer_fused_metrics_ok():
-            return (False, "device metric sums need a single (rank-2 "
-                    "output, rank-1 label) head")
+        if eval_metric is None:
+            if not self._infer_fused_metrics_ok():
+                return (False, "the default device metric sums need a "
+                        "single (rank-2 output, rank-1 label) head")
+        else:
+            spec = self._device_sum_spec(eval_metric)
+            if spec is None:
+                try:
+                    _, lshapes, out_shapes = self._bound_shapes()
+                    shapes = (" for outputs %s / labels %s"
+                              % ([tuple(s) for s in out_shapes],
+                                 [tuple(s) for s in lshapes]))
+                except Exception:
+                    shapes = ""
+                return (False, "metric %r declares no device-sum layout%s "
+                        "— it updates per-step on host"
+                        % (getattr(eval_metric, "name", eval_metric),
+                           shapes))
+            self._fused_metric_spec = spec
         mesh = self._exec_group._mesh
         if mesh is not None:
             from ..parallel.mesh import data_axis_size
@@ -647,26 +720,9 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
     def _fused_opt_state(self, params):
         """Optimizer state for the fused tree, seeded from preloaded updater
         states when present (load_optimizer_states round-trip)."""
-        states = dict(getattr(self._resolve_updater(), "states", None) or {})
-        idx_of = {n: i for i, n in enumerate(self._exec_group.param_names)}
-
-        def to_jnp(x):
-            if x is None:
-                return None
-            if isinstance(x, tuple):
-                return tuple(to_jnp(i) for i in x)
-            return x.data if hasattr(x, "data") else x
-
-        out = {}
-        for n, v in params.items():
-            if n in self._fused.frozen_param_names:
-                continue
-            idx = idx_of.get(n)
-            if idx is not None and idx in states:
-                out[n] = to_jnp(states[idx])
-            else:
-                out[n] = self._optimizer.create_fused_state(v)
-        return self._fused.cast_opt_state(out)
+        return _seed_opt_state(self._fused, params, self._optimizer,
+                               self._resolve_updater(),
+                               self._exec_group.param_names)
 
     def _try_fused_fit_step(self, data_batch, guard=None):
         """fit()'s fast path: one donated jit for fwd+bwd+update. Returns
@@ -774,8 +830,11 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
             # dist workers keep per-step dispatch: the per-step kvstore sync
             # semantics (and per-worker metric shards) are the contract
             return None
-        if not getattr(self, "_fused_metrics_ok", False):
-            return None  # multi-head / non-classification: per-step metrics
+        spec = self._fused_metric_spec
+        if spec is None and not getattr(self, "_fused_metrics_ok", False):
+            # no metric-declared packed layout AND the default layout's
+            # single-head shape requirement fails: per-step host metrics
+            return None
         if self._fused_state is None:
             # dropped by a divergence rollback: reseed from the restored
             # executor params + updater states
@@ -795,7 +854,8 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
         self._fused.health = guard.health if guard is not None else None
         try:
             self._fused_state, sums = self._fused.run_steps(
-                self._fused_state, batch, guard=guard is not None)
+                self._fused_state, batch, guard=guard is not None,
+                metric_spec=spec)
         except RetraceError as e:
             self._adopt_retrace_result(e, super_batch.num_steps, guard)
             raise

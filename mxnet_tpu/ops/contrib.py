@@ -227,15 +227,24 @@ def _multibox_detection(op_ctx, attrs, inputs, aux):
         sboxes = boxes[order]
         sscore = score[order]
         scls = cls[order]
-        ious = _iou(sboxes, sboxes)           # (k, k)
-        same_cls = (scls[:, None] == scls[None, :]) | force
-        sup_matrix = (ious > nms_thresh) & same_cls
+        from . import pallas_multibox as _pmb
+        if _pmb.enabled():
+            # escape-hatch kernel (MXTPU_PALLAS_MULTIBOX, docs/perf.md):
+            # the whole IOU + sequential suppression sweep VMEM-resident
+            # in ONE pallas_call instead of a k-trip While over HBM masks
+            alive = _pmb.nms_alive(
+                sboxes, sscore, scls, nms_thresh, force=force,
+                interpret=_pmb.interpret_requested()) > 0
+        else:
+            ious = _iou(sboxes, sboxes)           # (k, k)
+            same_cls = (scls[:, None] == scls[None, :]) | force
+            sup_matrix = (ious > nms_thresh) & same_cls
 
-        def body(i, alive):
-            sup = sup_matrix[i] & alive[i] & (jnp.arange(k) > i)
-            return alive & ~sup
+            def body(i, alive):
+                sup = sup_matrix[i] & alive[i] & (jnp.arange(k) > i)
+                return alive & ~sup
 
-        alive = jax.lax.fori_loop(0, k, body, sscore > 0)
+            alive = jax.lax.fori_loop(0, k, body, sscore > 0)
         out_cls = jnp.where(alive, scls.astype(cp.dtype), -1.0)
         out_score = jnp.where(alive, sscore, 0.0)
         det = jnp.concatenate([out_cls[:, None], out_score[:, None], sboxes],

@@ -1,0 +1,93 @@
+"""Pallas TPU escape-hatch kernel: the MultiBox greedy-NMS suppression
+sweep (docs/perf.md "Packed accumulators" — MultiBox A/B).
+
+Why this op is the escape-hatch candidate (per the r4 fusion post-mortem
+discipline: hand-fuse ONLY what XLA genuinely cannot): MultiBoxDetection's
+suppression is a sequentially-dependent sweep — anchor i may only suppress
+anchor j>i if i itself is still alive — which XLA lowers as a k-trip While
+loop over HBM-resident (k, k) masks; every trip re-reads the suppression
+matrix row and the alive vector. This kernel keeps the IOU matrix, the
+class mask and the alive vector VMEM-RESIDENT for the whole sweep: one
+pallas_call, one HBM read of the boxes/scores, one write of the final
+mask (k = nms_topk ≤ 400 → the (k, k) f32 IOU is ≤ 640 KiB, well inside
+the ~16 MiB VMEM envelope).
+
+Gated OFF by default behind ``MXTPU_PALLAS_MULTIBOX`` ("1" on TPU,
+"interpret" for CPU tests — the same spelling as MXTPU_FUSE_CONV_BN);
+docs/perf.md records the measured A/B. Ship-only-if-it-wins: the knob
+stays opt-in until a chip-host measurement shows a win.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _nms_kernel(boxes_ref, score_ref, cls_ref, alive_ref, *, nms_thresh,
+                force):
+    boxes = boxes_ref[...]                       # (k, 4) corners
+    score = score_ref[...][:, 0]                 # (k,)
+    cls = cls_ref[...][:, 0]                     # (k,)
+    k = boxes.shape[0]
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = (jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0))
+    area = jnp.maximum((x2 - x1) * (y2 - y1), 0.0)
+    union = area[:, None] + area[None, :] - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+    same = (cls[:, None] == cls[None, :]) | force
+    sup = (iou > nms_thresh) & same              # (k, k), VMEM-resident
+    later = jax.lax.broadcasted_iota(jnp.int32, (k,), 0)
+
+    def body(i, alive):
+        # row i suppresses strictly-later anchors, but only while i
+        # itself is still alive — the sequential dependence that keeps
+        # this a sweep rather than one reduction
+        row = jax.lax.dynamic_slice_in_dim(sup, i, 1, axis=0)[0]
+        ai = jax.lax.dynamic_slice_in_dim(alive, i, 1, axis=0)[0]
+        return alive & ~(row & ai & (later > i))
+
+    alive = jax.lax.fori_loop(0, k, body, score > 0)
+    alive_ref[...] = alive.astype(jnp.float32)[:, None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nms_thresh", "force", "interpret"))
+def nms_alive(sboxes, sscore, scls, nms_thresh, force=False,
+              interpret=False):
+    """Greedy class-aware NMS survival mask over score-sorted anchors:
+    ``sboxes`` (k, 4) corners, ``sscore`` (k,), ``scls`` (k,) ->
+    float32 (k,) 1.0/0.0 mask, semantics identical to the XLA
+    fori_loop formulation in ops/contrib.py (parity-tested)."""
+    k = sboxes.shape[0]
+    kern = functools.partial(_nms_kernel, nms_thresh=float(nms_thresh),
+                             force=bool(force))
+    alive = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((k, 1), jnp.float32),
+        interpret=interpret,
+    )(sboxes.astype(jnp.float32), sscore.astype(jnp.float32)[:, None],
+      scls.astype(jnp.float32)[:, None])
+    return alive[:, 0]
+
+
+def mode():
+    """The MXTPU_PALLAS_MULTIBOX knob: '' (off, default), '1' (on-TPU
+    compiled kernel), 'interpret' (interpreter — CPU tests/A-B)."""
+    import os
+    v = os.environ.get("MXTPU_PALLAS_MULTIBOX", "0").strip().lower()
+    return "" if v in ("", "0", "false", "off", "no") else v
+
+
+def enabled():
+    return mode() != ""
+
+
+def interpret_requested():
+    return mode() == "interpret" or jax.default_backend() != "tpu"

@@ -1020,14 +1020,37 @@ ZOO = {
     "transformer": dict(kwargs=dict(vocab_size=32, embed=16, num_heads=2,
                                     num_layers=1, seq_len=16),
                         data=(2, 16), label=(2, 16)),
+    # multi-head detection (rank-3 cls + loc heads + in-graph
+    # MultiBoxTarget matching): the packed-accumulator protocol's proof
+    # model — its label rides the net's OWN outputs, name "label"
+    "ssd": dict(kwargs=dict(num_classes=3, width=8),
+                data=(2, 3, 32, 32), label=(2, 2, 5),
+                label_name="label"),
 }
+
+
+def zoo_train_step(mname, optimizer="sgd", learning_rate=0.1):
+    """Build one zoo model's ``(TrainStep, data_shapes, label_shapes)`` —
+    ONE recipe shared by the tracecheck/memcheck/commscheck zoo gates
+    (per-model data/label names live in the ZOO config; SSD's label
+    variable is ``label``, not ``softmax_label``)."""
+    from . import models
+    from .train_step import TrainStep
+    if mname not in ZOO:
+        raise MXNetError("unknown zoo model %r (have %s)"
+                         % (mname, ", ".join(sorted(ZOO))))
+    cfg = ZOO[mname]
+    sym = models.get_symbol(mname, **cfg["kwargs"])
+    dname = cfg.get("data_name", "data")
+    lname = cfg.get("label_name", "softmax_label")
+    ts = TrainStep(sym, data_names=(dname,), label_names=(lname,),
+                   optimizer=optimizer, learning_rate=learning_rate)
+    return ts, {dname: cfg["data"]}, {lname: cfg["label"]}
 
 
 def check_zoo(names=None, k=2, guard=True, const_bytes=None, log=None):
     """Audit the model zoo's step programs; returns (findings, n_programs).
     ``names=None`` audits every shipped model."""
-    from . import models
-    from .train_step import TrainStep
     names = list(names) if names else sorted(ZOO)
     findings = []
     nprog = 0
@@ -1035,13 +1058,11 @@ def check_zoo(names=None, k=2, guard=True, const_bytes=None, log=None):
         if mname not in ZOO:
             raise MXNetError("tracecheck: unknown zoo model %r (have %s)"
                              % (mname, ", ".join(sorted(ZOO))))
-        cfg = ZOO[mname]
         if log:
             log("auditing %s ..." % mname)
-        sym = models.get_symbol(mname, **cfg["kwargs"])
-        ts = TrainStep(sym, optimizer="sgd", learning_rate=0.1)
+        ts, data_shapes, label_shapes = zoo_train_step(mname)
         findings += check_train_step(
-            ts, {"data": cfg["data"]}, {"softmax_label": cfg["label"]},
+            ts, data_shapes, label_shapes,
             k=k, guard=guard, const_bytes=const_bytes, name=mname)
         nprog += 4 if guard else 2
     return findings, nprog

@@ -38,23 +38,34 @@ _OPT_KEY_OFFSET = 1 << 20
 class StepMetrics(object):
     """Device-resident metric accumulators for one K-step dispatch.
 
-    Holds the packed ``[loss_sum, top1_correct, num_samples]`` array produced
-    on device by ``TrainStep.run_steps``; the first property access performs
-    the ONE host readback for the whole dispatch (and doubles as the sync
-    point per-step training got from reading outputs every batch).
+    Holds the packed accumulator array produced on device by
+    ``TrainStep.run_steps``; the first property access performs the ONE
+    host readback for the whole dispatch (and doubles as the sync point
+    per-step training got from reading outputs every batch).
+
+    Without a ``spec`` the layout is the legacy default
+    ``[loss_sum, top1_correct, num_samples]``. With a
+    :class:`~mxnet_tpu.metric.DeviceSumSpec` (the packed-accumulator
+    protocol, docs/perf.md "Packed accumulators") the layout is the
+    spec's declared slots — read them by name via :meth:`values`; the
+    ``loss_sum``/``num_samples`` properties then read the spec's
+    ``loss_slots`` pair (NaN / 0 when the spec declares none, which
+    makes the TrainingGuard skip its loss watch rather than observe
+    garbage).
 
     A GUARDED dispatch (``run_steps(..., guard=True)``) extends the packed
     array to ``[..., skipped, last_grad_norm]`` — the training-health
     sentinels ride back with the metric sums in the same single readback,
-    and skipped (non-finite) steps are already excluded from the
-    loss/correct/sample accumulators.
+    and skipped (non-finite) steps are already excluded from every
+    declared accumulator.
     """
 
-    __slots__ = ("device", "guarded", "_host")
+    __slots__ = ("device", "guarded", "spec", "_host")
 
-    def __init__(self, device_array, guarded=False):
+    def __init__(self, device_array, guarded=False, spec=None):
         self.device = device_array
         self.guarded = guarded
+        self.spec = spec
         self._host = None
 
     def _vals(self):
@@ -78,18 +89,46 @@ class StepMetrics(object):
         return self._host is not None
 
     @property
+    def _n_slots(self):
+        return 3 if self.spec is None else len(self.spec.slots)
+
+    def values(self):
+        """Slot-name -> float dict of the dispatch's accumulated sums
+        (spec layout; the legacy layout maps to loss_sum/top1_correct/
+        num_samples)."""
+        v = self._vals()
+        if self.spec is None:
+            return {"loss_sum": float(v[0]), "top1_correct": float(v[1]),
+                    "num_samples": float(v[2])}
+        return {s: float(v[i]) for i, s in enumerate(self.spec.slots)}
+
+    def _loss_pair(self):
+        v = self._vals()
+        if self.spec is None:
+            return float(v[0]), float(v[2])
+        if self.spec.loss_slots is None:
+            return float("nan"), 0.0
+        idx = {s: i for i, s in enumerate(self.spec.slots)}
+        ls, ns = self.spec.loss_slots
+        return float(v[idx[ls]]), float(v[idx[ns]])
+
+    @property
     def loss_sum(self):
-        """Summed cross-entropy over every sample in the dispatch."""
-        return float(self._vals()[0])
+        """Summed watchable loss over every sample in the dispatch (the
+        spec's declared loss pair; in-scan CE on the legacy layout)."""
+        return self._loss_pair()[0]
 
     @property
     def top1_correct(self):
-        """Count of top-1 correct predictions in the dispatch."""
+        """Count of top-1 correct predictions (legacy layout only; NaN
+        under a spec — read :meth:`values` by slot name instead)."""
+        if self.spec is not None:
+            return float("nan")
         return float(self._vals()[1])
 
     @property
     def num_samples(self):
-        return int(self._vals()[2])
+        return int(round(self._loss_pair()[1]))
 
     @property
     def accuracy(self):
@@ -105,34 +144,41 @@ class StepMetrics(object):
     def skipped(self):
         """Count of device-side no-op (non-finite) steps in the dispatch;
         0 for an unguarded dispatch."""
-        return int(self._vals()[3]) if self.guarded else 0
+        return int(self._vals()[self._n_slots]) if self.guarded else 0
 
     @property
     def last_grad_norm(self):
         """Global gradient norm of the dispatch's LAST step (guarded only;
         NaN/Inf when that step was the poisoned one — informative)."""
-        return float(self._vals()[4]) if self.guarded else None
+        if not self.guarded:
+            return None
+        return float(self._vals()[self._n_slots + 1])
 
     def __repr__(self):
-        s = ("StepMetrics(loss_sum=%.6g, top1_correct=%g, num_samples=%d"
-             % (self.loss_sum, self.top1_correct, self.num_samples))
+        if self.spec is None:
+            s = ("StepMetrics(loss_sum=%.6g, top1_correct=%g, "
+                 "num_samples=%d"
+                 % (self.loss_sum, self.top1_correct, self.num_samples))
+        else:
+            s = "StepMetrics(%s" % ", ".join(
+                "%s=%.6g" % kv for kv in sorted(self.values().items()))
         if self.guarded:
             s += ", skipped=%d, last_grad_norm=%g" % (self.skipped,
                                                       self.last_grad_norm)
         return s + ")"
 
 
-def _metric_step_sums(outs, batch, label_names, zero):
+def _metric_step_sums(outs, labels, zero):
     """One step's device metric sums (CE loss, top-1 correct) over every
     (rank-2 output, rank-1 label) pair, positionally. ONE definition shared
     by the unguarded scan, the guarded scan and the guarded single step —
     they are parity-tested against each other and against host
     metric.CrossEntropy (eps 1e-8) / metric.Accuracy (argmax axis=1), so
-    the accumulation must never drift between paths."""
+    the accumulation must never drift between paths. ``labels`` pairs with
+    ``outs`` positionally (None entries skip)."""
     loss = zero
     correct = zero
-    for o, lname in zip(outs, label_names):
-        lbl = batch.get(lname)
+    for o, lbl in zip(outs, labels):
         if (lbl is not None and getattr(o, "ndim", 0) == 2
                 and lbl.ndim == 1 and o.shape[0] == lbl.shape[0]):
             li = lbl.astype(jnp.int32)
@@ -153,6 +199,58 @@ def _metric_step_sums(outs, batch, label_names, zero):
                 (jnp.argmax(o, axis=1).astype(jnp.int32) == li)
                 .astype(jnp.float32))
     return loss, correct
+
+
+def _stable_sig(sig):
+    """Project a spec signature onto run-to-run-stable atoms for program
+    NAMING (the jit cache itself keys on the raw signature): function
+    objects — a CustomMetric's step_sums — repr with their memory
+    address, so they collapse to their qualname here."""
+    if isinstance(sig, tuple):
+        return tuple(_stable_sig(s) for s in sig)
+    if isinstance(sig, (str, int, float, bool)) or sig is None:
+        return sig
+    return getattr(sig, "__qualname__", type(sig).__name__)
+
+
+def _default_slot_sums(outs, labels, batch_size):
+    """The legacy packed layout ``(ce_loss, top1_correct, num_samples)``
+    as a slot tuple — what ``run_steps`` accumulates when no
+    packed-accumulator spec is passed (TrainStep API users, bench.py, the
+    multichip gate). Bit-for-bit the pre-protocol scan accumulation."""
+    zero = jnp.zeros((), jnp.float32)
+    loss, correct = _metric_step_sums(outs, labels, zero)
+    return (loss, correct, jnp.float32(batch_size))
+
+
+def _with_guard_loss(spec, batch_size):
+    """Augment a packed-accumulator spec that declares NO watchable loss
+    pair with two hidden slots — the in-scan CE loss and sample count the
+    TrainingGuard's divergence EMA has always observed. The metric's own
+    fold never sees the hidden slots; ``StepMetrics.loss_sum`` and the
+    guard do."""
+    from .metric import DeviceSumSpec
+    if spec is None or spec.loss_slots is not None:
+        return spec
+    base_slots = spec.slots
+    base_step = spec.step_sums
+    base_fold = spec.fold
+
+    def step_sums(outs, labels):
+        vals = tuple(base_step(outs, labels))
+        zero = jnp.zeros((), jnp.float32)
+        loss, _ = _metric_step_sums(outs, labels, zero)
+        return vals + (loss, jnp.float32(batch_size))
+
+    def fold(metric, values):
+        base_fold(metric, {s: values[s] for s in base_slots})
+
+    return DeviceSumSpec(
+        base_slots + ("__guard_loss", "__guard_n"), step_sums, fold,
+        ("guardloss",) + (spec.signature if isinstance(spec.signature,
+                                                       tuple)
+         else (spec.signature,)),
+        loss_slots=("__guard_loss", "__guard_n"), tag=spec.tag)
 
 
 class TrainStep(object):
@@ -601,8 +699,8 @@ class TrainStep(object):
             new_st, outs, (ok, gnorm) = step_fn(state, batch, key, lr,
                                                 poison)
             zero = jnp.zeros((), jnp.float32)
-            loss, correct = _metric_step_sums(outs, batch, label_names,
-                                              zero)
+            loss, correct = _metric_step_sums(
+                outs, [batch.get(n) for n in label_names], zero)
             okf = ok.astype(jnp.float32)
             packed = jnp.stack([
                 jnp.where(ok, loss, zero), jnp.where(ok, correct, zero),
@@ -612,70 +710,84 @@ class TrainStep(object):
 
         return jax.jit(fn, donate_argnums=(0,))
 
-    def _build_scan(self, batch_size, k, guard=False):
+    def _build_scan(self, batch_size, k, guard=False, metric_spec=None):
         """K steps in ONE compiled dispatch: lax.scan of the fused step body
         over a stacked (k, batch, ...) superbatch, state donated across the
         whole scan. This is the reference engine's bulking — whole graph
         segments per engine dispatch (SURVEY.md §3.1) — applied to the train
         loop itself: Python dispatch and host readback amortize over K steps.
 
-        Metric accumulators (CE loss sum, top-1 correct count, sample count)
-        are carried through the scan so metrics cross the host boundary once
-        per K steps. Accumulation pairs each rank-2 output with its label by
-        position, matching metric.CrossEntropy (eps 1e-8) / metric.Accuracy
-        (argmax axis=1) bit-for-bit over the same outputs.
+        Metric accumulators are carried through the scan so metrics cross
+        the host boundary once per K steps. Without ``metric_spec`` the
+        legacy layout (CE loss sum, top-1 correct count, sample count)
+        pairs each rank-2 output with its label by position, matching
+        metric.CrossEntropy (eps 1e-8) / metric.Accuracy (argmax axis=1)
+        bit-for-bit over the same outputs. With a
+        :class:`~mxnet_tpu.metric.DeviceSumSpec` (packed-accumulator
+        protocol, docs/perf.md "Packed accumulators") the carry holds the
+        spec's declared slots instead — any metric that declares a layout
+        rides the same one-readback-per-K contract.
 
         ``guard=True`` threads the training-health sentinels through the
         scan: a per-step NaN poison vector rides in next to ``lrs``, skipped
-        (non-finite) steps are excluded from every metric accumulator, and
-        the packed result grows to ``[loss, correct, nsamp, skipped,
-        last_grad_norm]`` — sentinels ride back with the metric sums in the
-        SAME single readback. The ``guard=False`` trace is unchanged.
+        (non-finite) steps are excluded from every accumulator slot, and
+        the packed result grows to ``[slots..., skipped, last_grad_norm]``
+        — sentinels ride back with the metric sums in the SAME single
+        readback. The ``guard=False`` trace is unchanged.
         """
         step_fn = self._make_step_fn(batch_size, guard=guard)
         label_names = list(self.label_names)
+        spec = metric_spec
+        if spec is not None:
+            nslots = len(spec.slots)
+
+            def slot_sums(outs, labels):
+                return tuple(spec.step_sums(outs, labels))
+        else:
+            nslots = 3
+
+            def slot_sums(outs, labels):
+                return _default_slot_sums(outs, labels, batch_size)
 
         def scan_fn(state, superbatch, key, lrs, poisons=None):
             zero = jnp.zeros((), jnp.float32)
 
             def body(carry, xs):
                 if guard:
-                    st, (loss, correct, nsamp, skipped, gnorm) = carry
+                    st, accs = carry
+                    slots, skipped, gnorm = \
+                        accs[:nslots], accs[nslots], accs[nslots + 1]
                     batch, lr, poison = xs
                     new_st, outs, (ok, g_norm) = step_fn(st, batch, key, lr,
                                                          poison)
                 else:
-                    st, (loss, correct, nsamp) = carry
+                    st, slots = carry
                     batch, lr = xs
                     new_st, outs = step_fn(st, batch, key, lr)
-                step_loss, step_correct = _metric_step_sums(
-                    outs, batch, label_names, zero)
+                step_vals = slot_sums(
+                    outs, [batch.get(n) for n in label_names])
                 if guard:
                     # skipped steps drop out of every accumulator: the
                     # metric denominators never see the poisoned batch
-                    loss = loss + jnp.where(ok, step_loss, zero)
-                    correct = correct + jnp.where(ok, step_correct, zero)
-                    nsamp = nsamp + jnp.where(ok, jnp.float32(batch_size),
-                                              zero)
+                    slots = tuple(a + jnp.where(ok, v, zero)
+                                  for a, v in zip(slots, step_vals))
                     skipped = skipped + jnp.where(ok, zero, jnp.float32(1))
-                    return (new_st, (loss, correct, nsamp, skipped,
-                                     g_norm.astype(jnp.float32))), None
-                loss = loss + step_loss
-                correct = correct + step_correct
-                nsamp = nsamp + jnp.float32(batch_size)
-                return (new_st, (loss, correct, nsamp)), None
+                    return (new_st, slots + (skipped,
+                                             g_norm.astype(jnp.float32))), \
+                        None
+                slots = tuple(a + v for a, v in zip(slots, step_vals))
+                return (new_st, slots), None
 
             if guard:
-                (state, (loss, correct, nsamp, skipped, gnorm)), _ = \
-                    jax.lax.scan(body,
-                                 (state, (zero, zero, zero, zero, zero)),
-                                 (superbatch, lrs, poisons))
-                return state, jnp.stack([loss, correct, nsamp, skipped,
-                                         gnorm])
-            (state, (loss, correct, nsamp)), _ = jax.lax.scan(
-                body, (state, (zero, zero, zero)), (superbatch, lrs))
+                zeros = tuple(zero for _ in range(nslots + 2))
+                (state, accs), _ = jax.lax.scan(
+                    body, (state, zeros), (superbatch, lrs, poisons))
+                return state, jnp.stack(list(accs))
+            zeros = tuple(zero for _ in range(nslots))
+            (state, slots), _ = jax.lax.scan(
+                body, (state, zeros), (superbatch, lrs))
             # one packed array => one host transfer for all K-step metrics
-            return state, jnp.stack([loss, correct, nsamp])
+            return state, jnp.stack(list(slots))
 
         return jax.jit(scan_fn, donate_argnums=(0,))
 
@@ -712,7 +824,8 @@ class TrainStep(object):
             [float("nan") if _faults.fire_flag("guard.grad_nan") else 0.0
              for _ in range(k)], np.float32)
 
-    def _tc_after(self, kind, cache_key, jitfn, call_args, result=None):
+    def _tc_after(self, kind, cache_key, jitfn, call_args, result=None,
+                  spec=None):
         """tracecheck runtime hook (docs/static_analysis.md), called right
         after a watched jit call: registers the program with the analyzer's
         registry (first call per cache entry — the guard-on / guard-off /
@@ -736,7 +849,24 @@ class TrainStep(object):
             self._watcher = _tc.make_watcher(
                 "TrainStep(%s)" % (self.symbol.name,))
         if isinstance(cache_key, tuple):
-            key = "%s[bs=%d,k=%d]" % ((kind,) + tuple(cache_key))
+            key = "%s[bs=%d,k=%d]" % (kind, cache_key[0], cache_key[1])
+            if len(cache_key) > 2:
+                # spec-keyed scan (packed-accumulator protocol): the
+                # metric tag (+ signature digest — two eps variants of
+                # one metric are distinct programs) keeps same-shape
+                # programs with different packed layouts distinct in the
+                # registry. crc32 over a STABILIZED repr, NOT hash():
+                # tuple hashes are PYTHONHASHSEED-salted, and a raw repr
+                # of a CustomMetric signature would embed its function
+                # object's memory address — either way a run-to-run-
+                # unstable program name silently unpins name-matched
+                # suppressions and drifts committed baselines
+                import zlib
+                tag = spec.tag if spec is not None else "spec"
+                key = "%s[bs=%d,k=%d,m=%s.%04x]" % (
+                    kind, cache_key[0], cache_key[1], tag,
+                    zlib.crc32(repr(_stable_sig(cache_key[2]))
+                               .encode()) & 0xffff)
         else:
             key = "%s[bs=%d]" % (kind, cache_key)
         name = "%s/%s" % (self._watcher.name, key)
@@ -797,7 +927,8 @@ class TrainStep(object):
         self._tc_after("step", bs, fn, call_args, result=out)
         return out
 
-    def run_steps(self, state, superbatch, k=None, guard=False):
+    def run_steps(self, state, superbatch, k=None, guard=False,
+                  metric_spec=None):
         """Run K fused train steps in ONE compiled dispatch.
 
         ``superbatch``: dict name -> stacked array of shape (k, batch, ...)
@@ -805,18 +936,25 @@ class TrainStep(object):
         or stack K batches yourself). The scheduler clock advances K host
         updates and the per-step lr schedule rides in as a traced (k,)
         vector, so schedules never retrace; the jit cache is keyed on
-        (batch_size, k), so a fixed K never recompiles across epochs.
+        (batch_size, k) — plus the metric spec's signature when one is
+        passed — so a fixed K never recompiles across epochs.
 
         Returns ``(new_state, metrics)`` where ``metrics`` is a
-        :class:`StepMetrics` holding the device-resident K-step accumulators
-        (loss sum, top-1 correct count, sample count) — reading any of its
-        properties performs the single host readback for the dispatch.
+        :class:`StepMetrics` holding the device-resident K-step
+        accumulators — reading any of its properties performs the single
+        host readback for the dispatch. Without ``metric_spec`` the
+        accumulators are the legacy (loss sum, top-1 correct count, sample
+        count); with a :class:`~mxnet_tpu.metric.DeviceSumSpec` they are
+        the spec's declared slots (read by name via ``metrics.values()``,
+        folded by ``metric.update_from_device_sums``).
 
         ``guard=True`` compiles the GUARDED scan (separate jit cache; the
         unguarded program is untouched): non-finite steps become device-side
         no-ops, are excluded from the metric accumulators, and the returned
         :class:`StepMetrics` additionally carries ``skipped`` and
-        ``last_grad_norm`` in the same single readback.
+        ``last_grad_norm`` in the same single readback. A spec with no
+        watchable loss pair is augmented with the in-scan CE loss so the
+        guard's divergence EMA keeps its observation.
         """
         vals = list(superbatch.values())
         if not vals:
@@ -832,10 +970,15 @@ class TrainStep(object):
                              % {n: tuple(v.shape)
                                 for n, v in superbatch.items()})
         bs = vals[0].shape[1]
+        if guard and metric_spec is not None:
+            metric_spec = _with_guard_loss(metric_spec, bs)
         cache = self._jit_scan_g if guard else self._jit_scan
-        if (bs, k) not in cache:
-            cache[(bs, k)] = self._build_scan(bs, k, guard=guard)
-        fn = cache[(bs, k)]
+        ckey = ((bs, k) if metric_spec is None
+                else (bs, k, metric_spec.signature))
+        if ckey not in cache:
+            cache[ckey] = self._build_scan(bs, k, guard=guard,
+                                           metric_spec=metric_spec)
+        fn = cache[ckey]
         # lr vector pinned through np.float32 BEFORE the device transfer:
         # the explicit f32 pin keeps the trace weak-type-free under any
         # jax config (tracecheck dtype lint), and jnp.asarray of a host
@@ -848,15 +991,15 @@ class TrainStep(object):
             call_args = (state, superbatch, self._dispatch_key(), lrs,
                          jnp.asarray(self._poison_scalars(k)))
             new_state, packed = fn(*call_args)
-            sums = StepMetrics(packed, guarded=True)
-            self._tc_after("guard-scan", (bs, k), fn, call_args,
-                           result=(new_state, sums))
+            sums = StepMetrics(packed, guarded=True, spec=metric_spec)
+            self._tc_after("guard-scan", ckey, fn, call_args,
+                           result=(new_state, sums), spec=metric_spec)
             return new_state, sums
         call_args = (state, superbatch, self._dispatch_key(), lrs)
         new_state, packed = fn(*call_args)
-        sums = StepMetrics(packed)
-        self._tc_after("scan", (bs, k), fn, call_args,
-                       result=(new_state, sums))
+        sums = StepMetrics(packed, spec=metric_spec)
+        self._tc_after("scan", ckey, fn, call_args,
+                       result=(new_state, sums), spec=metric_spec)
         return new_state, sums
 
     def shard_superbatch(self, superbatch):

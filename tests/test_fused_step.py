@@ -364,15 +364,48 @@ def test_module_fit_steps_per_dispatch_epoch_tail():
     assert seen[-1][1] == 96  # metric covered every sample
 
 
-def test_module_fit_unsupported_metric_falls_back():
+def test_module_fit_unsupported_metric_falls_back(caplog):
+    """A metric with NO declared packed layout (a CustomMetric without
+    the device_step_sums opt-in) falls back to k=1 — and the warning
+    names the metric, never silently (the zoo-dispatch gate pins this
+    contract)."""
+    import logging
     net = _mlp()
     it, X, y = _fit_data(shuffle=False)
     mod = mx.mod.Module(net)
-    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
-            optimizer_params={"learning_rate": 0.1},
-            eval_metric="mse", steps_per_dispatch=4)
+    metric = mx.metric.CustomMetric(
+        lambda label, pred: float((np.argmax(pred, 1) == label).mean()),
+        name="hostonly")
+    with caplog.at_level(logging.WARNING):
+        mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=metric, steps_per_dispatch=4)
     # fell back to per-step dispatch but still trained
     assert int(np.asarray(mod._fused_state["step"])) == 4
+    assert mod._fused._jit_scan == {}
+    assert any("steps_per_dispatch=4 unavailable" in r.message
+               and "hostonly" in r.message for r in caplog.records)
+
+
+def test_module_fit_mse_rides_packed_accumulators():
+    """MSE — the regression class that used to silently fall back to k=1
+    — now declares a packed layout and rides the fused scan; the train
+    metric matches the k=1 host fold."""
+    def train(k):
+        net = _mlp()
+        it, X, y = _fit_data(shuffle=False)
+        mod = mx.mod.Module(net)
+        mx.random.seed(3)
+        m = mx.metric.MSE()
+        mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.05},
+                eval_metric=m, steps_per_dispatch=k)
+        return mod, dict(m.get_name_value())["mse"]
+
+    mod4, mse4 = train(4)
+    assert any(key[:2] == (16, 4) for key in mod4._fused._jit_scan)
+    _, mse1 = train(1)
+    np.testing.assert_allclose(mse4, mse1, rtol=1e-5)
 
 
 def test_engine_bulk_scope_sets_fit_default():
@@ -385,14 +418,18 @@ def test_engine_bulk_scope_sets_fit_default():
         mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
                 optimizer_params={"learning_rate": 0.1})
     assert mx.engine.bulk_size() == 1
-    # the K-step scan path was engaged by the engine default
-    assert (16, 4) in mod._fused._jit_scan
+    # the K-step scan path was engaged by the engine default (the scan
+    # cache key carries the metric spec's signature since the
+    # packed-accumulator protocol)
+    assert any(k[:2] == (16, 4) for k in mod._fused._jit_scan)
 
 
-def test_module_fit_multihead_keeps_per_step_metrics():
-    """Two softmax heads: the in-scan accumulator would double-count, so
-    fit(steps_per_dispatch=k) must keep the per-step metric path — and the
-    reported accuracy must match the k=1 run exactly."""
+def test_module_fit_multihead_rides_packed_accumulators():
+    """Two softmax heads: under the packed-accumulator protocol Accuracy
+    declares a layout covering BOTH (rank-2 output, rank-1 label) pairs,
+    so fit(steps_per_dispatch=k) stays on the fused scan (the
+    pre-protocol code fell back to k=1 here) — and the reported accuracy
+    must match the k=1 host-metric run exactly."""
     def build():
         data = mx.sym.Variable("data")
         net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
@@ -433,8 +470,11 @@ def test_module_fit_multihead_keeps_per_step_metrics():
         return mod, dict(acc.get_name_value())["accuracy"]
 
     mod4, acc4 = train(4)
-    assert mod4._fused is not None and not mod4._fused_metrics_ok
-    assert mod4._fused._jit_scan == {}  # scan path never engaged
+    assert mod4._fused is not None
+    # the scan path engaged with the metric's own packed layout
+    assert any(k[:2] == (16, 4) for k in mod4._fused._jit_scan)
+    assert mod4._fused_metric_spec is not None
+    assert mod4._fused_metric_spec.slots == ("correct", "n")
     _, acc1 = train(1)
     np.testing.assert_allclose(acc4, acc1, rtol=1e-6)
 

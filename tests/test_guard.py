@@ -7,7 +7,7 @@ denominators, the unguarded fused program is untouched (no sentinel ops, no
 retrace), sustained loss spikes roll training back to the newest KNOWN-GOOD
 checkpoint with the lr reduced, and ``max_rollbacks`` ends in
 ``TrainingDivergedError``. Satellites: fused ``clip_global_norm`` parity
-vs. the imperative helper, the CrossEntropy eps device-sum gate,
+vs. the imperative helper, the CrossEntropy eps declared-constant specs,
 Speedometer health surfacing, known-good manifest refusal.
 """
 import json
@@ -584,67 +584,59 @@ def test_resume_refuses_manifest_without_known_good_bit(tmp_path, caplog):
     assert any("known-good" in r.message for r in caplog.records)
 
 
-# -- metric eps gate (satellite) --------------------------------------------
+# -- metric eps (packed-accumulator protocol, satellite) ---------------------
 
-def test_device_sums_rejects_nondefault_ce_eps():
+def test_device_sums_carry_nondefault_ce_eps():
+    """CrossEntropy(eps != 1e-8) now DECLARES its eps as a traced constant
+    in its packed-accumulator spec instead of raising — distinct eps
+    values are distinct jit-cache signatures, composites concatenate."""
     m = mx.metric.CrossEntropy(eps=1e-5)
-    with pytest.raises(MXNetError) as ei:
-        mx.metric.supports_device_sums(m)
-    msg = str(ei.value)
-    assert "cross-entropy" in msg and "1e-05" in msg and "1e-8" in msg
-    # default eps still rides the device-sum path; composites propagate
-    assert mx.metric.supports_device_sums(mx.metric.CrossEntropy())
-    comp = mx.metric.create(["acc", "ce"])
-    assert mx.metric.supports_device_sums(comp)
-    comp.add(mx.metric.CrossEntropy(eps=1e-5))
-    with pytest.raises(MXNetError, match="eps"):
-        mx.metric.supports_device_sums(comp)
-    # order-independent: the rejection fires with the CE in ANY position
-    comp2 = mx.metric.CompositeEvalMetric(
+    assert mx.metric.supports_device_sums(m)
+    sp = mx.metric.device_sum_spec(m, [(4, 3)], [(4,)])
+    sp8 = mx.metric.device_sum_spec(mx.metric.CrossEntropy(),
+                                    [(4, 3)], [(4,)])
+    assert sp.signature != sp8.signature
+    # the traced eps actually differs: same inputs, different loss
+    import jax.numpy as jnp
+    o = jnp.asarray(np.full((4, 3), 1.0 / 3.0, np.float32))
+    l = jnp.asarray(np.zeros(4, np.float32))
+    v5 = float(sp.step_sums([o], [l])[0])
+    v8 = float(sp8.step_sums([o], [l])[0])
+    host = mx.metric.CrossEntropy(eps=1e-5)
+    host.update([np.asarray(l)], [np.asarray(o)])
+    np.testing.assert_allclose(v5, host.sum_metric, rtol=1e-6)
+    assert v5 != v8
+    # composites concatenate child specs, any position
+    comp = mx.metric.CompositeEvalMetric(
         [mx.metric.CrossEntropy(eps=1e-5), mx.metric.Accuracy()])
-    with pytest.raises(MXNetError, match="eps"):
-        mx.metric.supports_device_sums(comp2)
-    # ...but NOT when a sibling already forces the per-step fallback
-    # (where any eps works — raising would demand a fix that can't help)
-    comp3 = mx.metric.CompositeEvalMetric(
-        [mx.metric.MSE(), mx.metric.CrossEntropy(eps=1e-5)])
-    assert mx.metric.supports_device_sums(comp3) is False
+    assert mx.metric.supports_device_sums(comp)
+    # ...but one spec-less child still forces the per-step fallback
+    comp2 = mx.metric.CompositeEvalMetric(
+        [mx.metric.F1(), mx.metric.CrossEntropy(eps=1e-5)])
+    assert mx.metric.supports_device_sums(comp2) is False
 
 
-def test_fit_nondefault_ce_eps_rejected_under_bulking():
-    X, y = _toy_data(64)
-    train = mx.io.NDArrayIter(X, y, batch_size=16)
-    mod = mx.mod.Module(_mlp(), context=mx.cpu())
-    with pytest.raises(MXNetError, match="steps_per_dispatch=1"):
-        mod.fit(train, num_epoch=1,
+def test_fit_nondefault_ce_eps_parity_under_bulking():
+    """fit(steps_per_dispatch=4) with CrossEntropy(eps=1e-5) rides the
+    fused scan and reports the SAME metric as the k=1 host-update run —
+    the parity the old hard raise existed to protect, now guaranteed by
+    the declared-constant spec."""
+    def train(k):
+        X, y = _toy_data(64)
+        train_it = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mx.random.seed(5)
+        m = mx.metric.CrossEntropy(eps=1e-5)
+        mod.fit(train_it, num_epoch=2,
+                initializer=mx.initializer.Xavier(),
                 optimizer_params={"learning_rate": 0.1},
-                eval_metric=mx.metric.CrossEntropy(eps=1e-5),
-                steps_per_dispatch=4)
+                eval_metric=m, steps_per_dispatch=k)
+        return mod, dict(m.get_name_value())["cross-entropy"]
 
-
-def test_fit_nondefault_ce_eps_ok_when_bulking_ineligible(caplog):
-    """The eps rejection must only fire when the run would otherwise take
-    the device-sum path: a module that can't bulk anyway (multi-head)
-    falls back per-step, where the host metric honors any eps."""
-    data = sym.Variable("data")
-    net = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
-    a = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4, name="ha"),
-                          name="sa")
-    b = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4, name="hb"),
-                          name="sb")
-    X, y = _toy_data(32)
-    train = mx.io.NDArrayIter(X, {"sa_label": y, "sb_label": y},
-                              batch_size=16)
-    mod = mx.mod.Module(sym.Group([a, b]),
-                        label_names=("sa_label", "sb_label"),
-                        context=mx.cpu())
-    with caplog.at_level(logging.WARNING):
-        mod.fit(train, num_epoch=1,
-                optimizer_params={"learning_rate": 0.1},
-                eval_metric=mx.metric.CrossEntropy(eps=1e-5),
-                steps_per_dispatch=4)
-    assert any("steps_per_dispatch=4 unavailable" in r.message
-               for r in caplog.records)
+    mod4, ce4 = train(4)
+    assert any(key[:2] == (16, 4) for key in mod4._fused._jit_scan)
+    _, ce1 = train(1)
+    np.testing.assert_allclose(ce4, ce1, rtol=1e-5)
 
 
 # -- observability (satellite) ----------------------------------------------
