@@ -133,6 +133,26 @@ def _peak_flops(device):
     return None, kind
 
 
+def _obs_block():
+    """The unified-observability block every bench mode's JSON line
+    carries (docs/observability.md): one metrics-registry snapshot — the
+    five legacy health/stats objects ride it as views — plus host-tracer
+    status and per-name span counts when MXTPU_TRACE=1."""
+    from mxnet_tpu import obs
+    snap = obs.REGISTRY.snapshot()
+    block = {"trace_enabled": obs.enabled(),
+             "counters": {k: v for k, v in sorted(snap.items())
+                          if not k.endswith("last_error")}}
+    if obs.enabled():
+        by = {}
+        for ev in obs.events():
+            if ev.get("ph") in ("X", "i"):
+                by[ev["name"]] = by.get(ev["name"], 0) + 1
+        block["span_counts"] = by
+        block["trace_path"] = obs.trace.trace_path()
+    return block
+
+
 def host_overhead_main():
     """Host-overhead mode: measure what checkpointing + metric readback
     COST the train loop, and how much of it the async writer + dispatch
@@ -229,6 +249,7 @@ def host_overhead_main():
         "retraces": tracecheck.retrace_count(),
         "sweep": sweep,
     }
+    out["obs"] = _obs_block()
     print(json.dumps(out))
 
 
@@ -371,6 +392,7 @@ def zoo_dispatch_main():
         "findings": len(findings),
         "retraces": tracecheck.retrace_count(),
     }
+    out["obs"] = _obs_block()
     print(json.dumps(out))
     if failed:
         raise SystemExit("BENCH_ZOO_DISPATCH gate: %s fell back to k=1 — "
@@ -551,6 +573,7 @@ def realdata_main():
         "tracecheck_findings": len(findings),
         "retraces": tracecheck.retrace_count(),
     }
+    out["obs"] = _obs_block()
     print(json.dumps(out))
     if ratio < min_ratio:
         raise SystemExit(
@@ -688,6 +711,7 @@ def serve_main():
         "retraces": tracecheck.retrace_count(),
     }
     out.update(mem_fields)
+    out["obs"] = _obs_block()
     print(json.dumps(out))
 
 
@@ -897,6 +921,7 @@ def fleet_main():
                           completed=len(lat[c]))
     out["single"] = dict(_percentiles_ms(sum(lat1.values(), [])),
                          completed=done1)
+    out["obs"] = _obs_block()
     print(json.dumps(out))
 
 
@@ -1234,6 +1259,7 @@ def main():
     if dp_n > 1:
         out["dp"] = _dp_scaling_row(sym, dshape, batch, sdtype, cdtype,
                                     remat, spd, rounds)
+    out["obs"] = _obs_block()
     print(json.dumps(out))
 
 

@@ -1,0 +1,14 @@
+#!/bin/sh
+# CI gate: unified observability (docs/observability.md). Runs a small
+# fused fit and a batcher serve run under MXTPU_TRACE=1 and
+# schema-validates the emitted Chrome trace (expected stages present,
+# spans properly nested, dispatch/request correlation IDs consistent),
+# asserts the metrics-registry snapshot carries every legacy health key
+# (the five process-global counter objects as views), and A/Bs that
+# tracing-off keeps obs.span a flag-check no-op (ns-bounded) with no
+# measurable per-dispatch fit cost.
+set -e
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    python tools/obs_gate.py
+echo "obs PASS"

@@ -41,6 +41,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # drain+rejoin; zero failed/shed requests, per-class p99 cap, zero
 # static findings across every replica's program set
 ./ci/fleet.sh
+# observability gate (docs/observability.md): fused fit + batcher serve
+# under MXTPU_TRACE=1 — Chrome-trace schema validation (stages present,
+# spans nested, dispatch/request IDs consistent), registry snapshot
+# carries every legacy health key, tracing-off cost A/B
+./ci/obs.sh
 # real-data input-tier smoke (docs/perf.md "Device-fed input pipeline"):
 # small real-JPEG epoch through reader -> decode workers -> prefetch ->
 # fused scan; gates the real/synthetic throughput ratio floor

@@ -5,6 +5,7 @@ See SURVEY.md at the repo root for the structural map of the reference
 (lyttonhao/mxnet, v0.9.5) this framework reproduces, TPU-first.
 """
 from .base import MXNetError, __version__
+from . import obs
 from . import faults
 from . import guard
 from .guard import TrainingGuard, TrainingHealth, TrainingDivergedError

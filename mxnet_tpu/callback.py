@@ -52,7 +52,16 @@ class Speedometer(object):
     """Log samples/sec every ``frequent`` batches (ref: callback.py
     Speedometer). A guarded run (docs/robustness.md "Numerical guardrails")
     appends the ``TrainingHealth`` counters — skipped batches, rollbacks,
-    last grad-norm — so a limping run is diagnosable from the log alone."""
+    last grad-norm — so a limping run is diagnosable from the log alone.
+
+    Every windowed suffix (``Pipeline:``, ``Data:``, ``Retraces:``) rides
+    ONE baseline mechanism — :class:`mxnet_tpu.obs.registry.Window`, keyed
+    to its source object (docs/observability.md) — instead of the four
+    hand-rolled per-suffix baselines whose reuse/interleave bugs PRs 4/5
+    each fixed separately. The keying is what prevents both historical
+    leaks: a REUSED Speedometer rebases at (re-)init, and an INTERLEAVED
+    foreign stream (score(), another run's callbacks) carries a different
+    source object, so it can never advance this run's baselines."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
@@ -61,9 +70,9 @@ class Speedometer(object):
         self.tic = 0
         self.last_count = 0
         self._fired = 0
-        self._stall_seen = 0.0  # pipeline host_stall at the last fire
-        self._data_stall_seen = 0.0  # input-tier stall at the last fire
-        self._retrace_base = None  # tracecheck retrace count at init-fire
+        #: (suffix-name, source-identity) -> obs.registry.Window — the one
+        #: baseline store behind every windowed suffix
+        self._windows = {}
 
     @staticmethod
     def _speed_scale(param):
@@ -84,13 +93,43 @@ class Speedometer(object):
         except Exception:
             return 1.0
 
+    def _window_for(self, name, source_obj, fn):
+        """Get-or-create the :class:`~mxnet_tpu.obs.registry.Window` for
+        (suffix, source identity). A NEW source object (a different run's
+        pipeline/stats) gets a fresh window baselined at its current
+        reading, so runs can interleave on one Speedometer without
+        cross-charging each other's accumulation.
+
+        The store holds its sources only by WEAK reference (``fn`` must
+        read through a weakref too — see the suffix builders): a
+        long-lived Speedometer reused across many runs prunes each dead
+        run's entry here instead of pinning its pipeline/stats objects
+        forever."""
+        import weakref
+        from .obs.registry import Window
+        for k in [k for k, (wr, _) in self._windows.items()
+                  if wr is not None and wr() is None]:
+            del self._windows[k]
+        key = (name, id(source_obj) if source_obj is not None else None)
+        ent = self._windows.get(key)
+        if ent is not None:
+            wr, w = ent
+            if (wr() if wr is not None else None) is source_obj:
+                return w
+        wr = (weakref.ref(source_obj) if source_obj is not None else None)
+        w = Window(fn)
+        self._windows[key] = (wr, w)
+        return w
+
     @staticmethod
     def _health_suffix(param):
         """THIS run's TrainingHealth counters when it is guarded, empty
         otherwise — strictly per-run: the guard rides in through
         ``param.locals`` (fit exposes its locals there), never the
         process-global ``TRAINING_HEALTH`` mirror, whose aggregate would
-        leak one run's counters into another run's (or score()'s) lines."""
+        leak one run's counters into another run's (or score()'s) lines.
+        Displayed values are run-cumulative: the per-run health object IS
+        the baseline (it starts at zero with the run)."""
         loc = getattr(param, "locals", None)
         g = loc.get("guard") if isinstance(loc, dict) else None
         if g is None:
@@ -106,56 +145,66 @@ class Speedometer(object):
     def _pipeline_suffix(self, param):
         """THIS run's dispatch-pipeline counters (docs/perf.md "Host off
         the critical path"): depth plus the host-stall seconds spent
-        blocked in packed-readbacks since the last fire — read strictly
-        via ``param.locals`` like the Guard suffix, so one run's counters
-        never leak into another's lines. Empty in eager mode."""
+        blocked in packed-readbacks since the last fire. The window is
+        keyed to the pipeline object: an eager pipeline still advances its
+        own baseline, and a param from another callback stream (a
+        different — or no — pipeline) can never reset this run's. Empty in
+        eager mode."""
+        import weakref
         loc = getattr(param, "locals", None)
         p = loc.get("pipeline") if isinstance(loc, dict) else None
-        if p is None or getattr(p, "depth", 0) <= 0:
-            # an eager pipeline still advances the baseline; a param from
-            # another callback stream (no pipeline in locals) must NOT
-            # reset it — that would attribute the pipelined run's whole
-            # accumulated stall to its next window
-            if p is not None:
-                self._stall_seen = p.host_stall or 0.0
+        if p is None:
             return ""
-        stall = p.host_stall
-        window = max(0.0, stall - self._stall_seen)
-        self._stall_seen = stall
+        wr = weakref.ref(p)
+        w = self._window_for(
+            "pipeline", p,
+            lambda: {"host_stall": getattr(wr(), "host_stall", 0.0)
+                     or 0.0})
+        d = w.delta()
+        if getattr(p, "depth", 0) <= 0:
+            return ""
         return ("\tPipeline: depth=%d host_stall=%.3fs"
-                % (p.depth, window))
+                % (p.depth, max(0.0, d["host_stall"])))
 
     def _data_suffix(self, param):
         """THIS run's input-tier window (docs/perf.md "Device-fed input
         pipeline"): the seconds the training loop spent stalled waiting on
         data since the last fire, plus the prefetch queue's average depth —
         a growing stall with an empty queue is the input-bound signature.
-        Read strictly via ``param.locals`` like the other suffixes; empty
+        Window keyed to the stats object like the other suffixes; empty
         when the run has no instrumented input pipeline."""
+        import weakref
         loc = getattr(param, "locals", None)
         st = loc.get("data_stats") if isinstance(loc, dict) else None
         if st is None:
             return ""
-        stall = st.stage_seconds("stall")
-        window = max(0.0, stall - self._data_stall_seen)
-        self._data_stall_seen = stall
+        wr = weakref.ref(st)
+        w = self._window_for(
+            "data", st,
+            lambda: {"stall": (st_.stage_seconds("stall")
+                               if (st_ := wr()) is not None else 0.0)})
+        d = w.delta()
         rep = st.report()
         q = rep.get("queue_depth_avg")
         return ("\tData: stall=%.3fs q=%s"
-                % (window, "%.1f" % q if q is not None else "n/a"))
+                % (max(0.0, d["stall"]),
+                   "%.1f" % q if q is not None else "n/a"))
 
-    def _retrace_suffix(self):
+    def _retrace_suffix(self, init=False):
         """``Retraces: N`` once any watched jit entry has unexpectedly
         re-traced since this Speedometer started (docs/static_analysis.md):
         a jit-cache-miss storm — every retrace is a full recompile — shows
         up in the training log itself, not just as a benchmark delta. The
-        count is baselined at the first (init) fire so one run's misses
-        never leak into another run's lines."""
+        window baselines at the (re-)init fire and reads by ``peek`` — the
+        count is cumulative SINCE INIT, and a reused Speedometer never
+        reports another run's misses."""
         from . import tracecheck
-        n = tracecheck.retrace_count()
-        if self._retrace_base is None:
-            self._retrace_base = n
-        n -= self._retrace_base
+        w = self._window_for("retraces", None,
+                            lambda: {"count": tracecheck.retrace_count()})
+        if init:
+            w.rebase()
+            return ""
+        n = w.peek()["count"]
         return "\tRetraces: %d" % n if n else ""
 
     def __call__(self, param):
@@ -194,14 +243,14 @@ class Speedometer(object):
             self.init = True
             self._fired = count
             self.tic = time.time()
-            # baseline the pipeline/data stall + retrace counters so the
+            # baseline the pipeline/data stall + retrace windows so the
             # first fired window reports its own stall/misses, not the
             # run-up — re-baselined on every (re-)init so a reused
-            # Speedometer never reports another run's cache misses
+            # Speedometer never reports another run's cache misses (one
+            # mechanism: obs.registry.Window, keyed per source)
             self._pipeline_suffix(param)
             self._data_suffix(param)
-            self._retrace_base = None
-            self._retrace_suffix()
+            self._retrace_suffix(init=True)
 
 
 class ProgressBar(object):

@@ -57,13 +57,24 @@ class DevicePrefetcher(mxio.SuperBatchIter):
         """The training loop's wait for the next superbatch: queue-depth
         sample plus the stall charge — when this time is a large fraction
         of wall clock the run is input-bound, and ``stall_frac`` in the
-        bench JSON / Speedometer suffix says so directly."""
+        bench JSON / Speedometer suffix says so directly. The wait also
+        lands as a ``data_wait`` host span carrying the superbatch's
+        correlation index (docs/observability.md)."""
+        from ..obs import trace as _obs
         self.stats.note_queue_depth(self._queue.qsize())
         t0 = time.perf_counter()
+        item = None
         try:
-            return super()._queue_get_checked()
+            item = super()._queue_get_checked()
+            return item
         finally:
-            self.stats.add("stall", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.stats.add("stall", dt)
+            # emitted after the fact (the index rides ON the item): the
+            # complete event backdates ts by its duration, so Perfetto
+            # renders it exactly where the wait happened
+            _obs.complete("data_wait", dt,
+                          dispatch=getattr(item, "sb_seq", None))
 
     def set_epoch(self, epoch):
         """Pin the BASE iterator to ``epoch``'s deterministic order and

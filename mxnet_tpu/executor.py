@@ -269,6 +269,11 @@ class Executor(object):
         return self._outputs_nd
 
     def forward(self, is_train=False, **kwargs):
+        # deferred MXNET_PROFILER_AUTOSTART (docs/observability.md): the
+        # device trace starts at the FIRST dispatch, after any
+        # profiler_set_config — one boolean check once resolved
+        from . import profiler as _profiler
+        _profiler.maybe_autostart()
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("forward: unknown argument %r" % k)

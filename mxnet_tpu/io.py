@@ -509,6 +509,14 @@ class SuperBatchIter(DataIter):
         self._stop = None
         self._done = False
         self._held = None  # first batch of the NEXT bucket run (bucketed)
+        # superbatch sequence counter: the end-to-end correlation ID for
+        # host-span tracing (docs/observability.md) — the producer stamps
+        # each assembled superbatch with ``sb_seq``, fit's dispatch /
+        # readback / checkpoint spans carry the same index, so one
+        # dispatch reads as one Perfetto timeline across threads. Only
+        # the assembly thread touches these (single producer).
+        self._sb_seq = 0
+        self._cur_sb = None
         if prefetch:
             self._start_producer()
 
@@ -593,11 +601,14 @@ class SuperBatchIter(DataIter):
         like any transient IO: a flaky transfer costs a retry, not the
         run."""
         from . import faults as _faults
+        from .obs import trace as _obs
         raw = [p.data if isinstance(p, NDArray) else p for p in parts]
         if all(isinstance(r, np.ndarray) for r in raw):
             t0 = time.perf_counter()
             stacked = np.stack(raw)
-            self._note_stage("stack", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._note_stage("stack", dt)
+            _obs.complete("stack", dt, dispatch=self._cur_sb)
 
             def land():
                 _faults.fire("io.h2d")
@@ -617,24 +628,32 @@ class SuperBatchIter(DataIter):
                 return retry_call(land, "io.h2d", self.retry_policy,
                                   self.data_health)
             finally:
-                self._note_stage("h2d", time.perf_counter() - t0,
-                                 n=len(parts))
+                dt = time.perf_counter() - t0
+                self._note_stage("h2d", dt, n=len(parts))
+                _obs.complete("h2d", dt, dispatch=self._cur_sb)
         import jax.numpy as jnp
         t0 = time.perf_counter()
         out = jnp.stack([jnp.asarray(r) for r in raw])
         if self.sharding is not None:
             import jax
             out = jax.device_put(out, self.sharding)
-        self._note_stage("h2d", time.perf_counter() - t0, n=len(parts))
+        dt = time.perf_counter() - t0
+        self._note_stage("h2d", dt, n=len(parts))
+        _obs.complete("h2d", dt, dispatch=self._cur_sb)
         return NDArray(out)
 
     def _assemble(self, group):
+        from .obs import trace as _obs
+        self._cur_sb = self._sb_seq
+        self._sb_seq += 1
         n_data = len(group[0].data)
         n_label = len(group[0].label or [])
-        data = [self._stack([b.data[i] for b in group])
-                for i in range(n_data)]
-        label = [self._stack([b.label[i] for b in group])
-                 for i in range(n_label)]
+        with _obs.span("superbatch_assemble", dispatch=self._cur_sb,
+                       k=len(group)):
+            data = [self._stack([b.data[i] for b in group])
+                    for i in range(n_data)]
+            label = [self._stack([b.label[i] for b in group])
+                     for i in range(n_label)]
         # bucketed batches carry their own per-bucket descriptors: the
         # stacked descs must come from the GROUP's shapes, not the base
         # iterator's default-bucket ones
@@ -644,12 +663,16 @@ class SuperBatchIter(DataIter):
                         if step_pd is not None else self.provide_data)
         provide_label = (self._stacked_descs(step_pl)
                          if step_pl is not None else self.provide_label)
-        return SuperDataBatch(
+        sb = SuperDataBatch(
             data=data, label=label, pads=[b.pad or 0 for b in group],
             num_steps=len(group), provide_data=provide_data,
             provide_label=provide_label,
             bucket_key=getattr(group[0], "bucket_key", None),
             step_provide_data=step_pd, step_provide_label=step_pl)
+        # stamp the correlation ID so fit's dispatch/readback/checkpoint
+        # spans share this superbatch's index (docs/observability.md)
+        sb.sb_seq = self._cur_sb
+        return sb
 
     # -- producer thread -----------------------------------------------
     def _start_producer(self):

@@ -204,11 +204,18 @@ class KVStore(object):
             if on_degraded is not None:
                 on_degraded()
         else:
-            raise WorkerLostError(
-                "%d dead worker(s) across %d consecutive health checks; "
-                "BSP training cannot progress — restart from the last "
-                "checkpoint (resume='auto') with a healthy worker set"
-                % (dead, self._dead_strikes))
+            msg = ("%d dead worker(s) across %d consecutive health checks; "
+                   "BSP training cannot progress — restart from the last "
+                   "checkpoint (resume='auto') with a healthy worker set"
+                   % (dead, self._dead_strikes))
+            # post-mortem before the escalation unwinds: the flight
+            # recorder's dump never raises (docs/observability.md)
+            from .obs import flight as _flight
+            _flight.dump("WorkerLostError: %s" % msg,
+                         extra={"dead_workers": dead,
+                                "strikes": self._dead_strikes,
+                                "rank": self.rank})
+            raise WorkerLostError(msg)
         return dead
 
     @property

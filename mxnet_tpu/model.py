@@ -408,7 +408,13 @@ class AsyncCheckpointWriter(object):
                 return  # simulated abrupt death: stays wedged until reaped
             try:
                 _faults.fire("ckpt.async_write")
-                fn()
+                # the host-heavy half of an async save lands as its own
+                # span on the WRITER thread's Perfetto track — beside the
+                # training thread's cheap "checkpoint" snapshot span
+                # (docs/observability.md)
+                from .obs import trace as _obs
+                with _obs.span("checkpoint_write", async_=True):
+                    fn()
                 with self._cond:
                     self.written += 1
             except BaseException as exc:

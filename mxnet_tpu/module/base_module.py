@@ -14,6 +14,7 @@ from ..base import MXNetError, env_bool
 from .. import metric as _metric
 from .. import ndarray as nd
 from ..ndarray import NDArray
+from ..obs import trace as _obs_trace
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -43,7 +44,11 @@ class _DispatchPipeline(object):
     ``host_stall_frac`` read it.
     """
 
-    __slots__ = ("depth", "_pending", "dispatches", "retired", "host_stall")
+    # __weakref__: the Speedometer's windowed-suffix store holds its
+    # sources weakly (callback.py _window_for) — a slots class without it
+    # cannot be weak-referenced
+    __slots__ = ("depth", "_pending", "dispatches", "retired",
+                 "host_stall", "__weakref__")
 
     def __init__(self, depth):
         self.depth = max(0, int(depth))
@@ -55,12 +60,16 @@ class _DispatchPipeline(object):
     def __len__(self):
         return len(self._pending)
 
-    def push(self, sums, nsteps, nbatch):
+    def push(self, sums, nsteps, nbatch, disp=None):
         """Enqueue one dispatch's device-resident sums; returns the list of
         ``(sums, nsteps, nbatch)`` entries that fell out of the window
-        (fetched, ready to fold into metric/guard)."""
+        (fetched, ready to fold into metric/guard). ``disp`` is the
+        dispatch correlation index the readback span reports
+        (docs/observability.md); defaults to the push ordinal."""
+        if disp is None:
+            disp = self.dispatches
         self.dispatches += 1
-        self._pending.append((sums, nsteps, nbatch))
+        self._pending.append((sums, nsteps, nbatch, disp))
         out = []
         while len(self._pending) > self.depth:
             out.append(self._fetch_one())
@@ -82,12 +91,15 @@ class _DispatchPipeline(object):
         self._pending.clear()
 
     def _fetch_one(self):
-        sums, nsteps, nbatch = self._pending.popleft()
+        from ..obs import trace as _obs
+        sums, nsteps, nbatch, disp = self._pending.popleft()
         t0 = time.perf_counter()
         sums.fetch()
-        self.host_stall += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        _obs.complete("readback_stall", dt, dispatch=disp)
+        self.host_stall += dt
         self.retired += 1
-        return sums, nsteps, nbatch
+        return sums, nsteps, nbatch, disp
 
 
 class BaseModule(object):
@@ -428,7 +440,8 @@ class BaseModule(object):
             metric exactly as the eager mode would have at the same
             nbatch (the fold+fire sequence is what the bitwise
             pipelined-vs-eager parity contract pins)."""
-            for sums, nsteps, nb in entries:
+            from .. import obs as _obs
+            for sums, nsteps, nb, disp in entries:
                 _metric.update_from_device_sums(eval_metric, sums)
                 if guard is not None:
                     guard.on_dispatch(loss_sum=sums.loss_sum,
@@ -438,6 +451,11 @@ class BaseModule(object):
                                       nsteps=nsteps)
                 if note_retired is not None:
                     note_retired(sums, nsteps)
+                # flight recorder: the per-dispatch counter delta rides
+                # the marks ring so a post-mortem shows what each of the
+                # last K dispatches changed (docs/observability.md)
+                _obs.flight.note("dispatch_retired", dispatch=disp,
+                                 nbatch=nb, nsteps=nsteps)
                 if batch_end_callback is not None:
                     cb_params = BatchEndParam(
                         epoch=epoch, nbatch=nb, eval_metric=eval_metric,
@@ -447,6 +465,11 @@ class BaseModule(object):
                     for callback in _as_list(batch_end_callback):
                         callback(cb_params)
 
+        # flight-recorder baseline (docs/observability.md): mark the run
+        # start so the FIRST retired dispatch's counter delta covers that
+        # dispatch, not "everything since the process began"
+        from ..obs import flight as _obs_flight
+        _obs_flight.note("fit_start", epoch=begin_epoch)
         try:
             epoch = begin_epoch
             while epoch < num_epoch:
@@ -490,15 +513,25 @@ class BaseModule(object):
                         # DEFERRED through the pipeline so dispatch N+1 is
                         # enqueued before dispatch N's np.asarray
                         sums = None
+                        disp_id = getattr(data_batch, "sb_seq",
+                                          pipeline.dispatches)
                         if (tail_batches is None and k > 1
                                 and getattr(data_batch, "num_steps", 0) == k
                                 and fused_dispatch is not None):
-                            sums = fused_dispatch(data_batch, guard)
+                            # the "dispatch" span is the ENQUEUE — the
+                            # device-side scan runs async; its readback is
+                            # the correlated readback_stall span
+                            with _obs_trace.span("dispatch",
+                                                 dispatch=disp_id,
+                                                 k=data_batch.num_steps,
+                                                 epoch=epoch):
+                                sums = fused_dispatch(data_batch, guard)
                         if sums is not None:
                             nbatch += data_batch.num_steps
                             since_ckpt += data_batch.num_steps
                             _consume(pipeline.push(
-                                sums, data_batch.num_steps, nbatch), epoch)
+                                sums, data_batch.num_steps, nbatch,
+                                disp=disp_id), epoch)
                         else:
                             # per-step path: the general executor loop, also
                             # the epoch tail (num_steps < k) without a
@@ -554,8 +587,12 @@ class BaseModule(object):
                                 # save keeps the newest known-good checkpoint
                                 # PRE-spike, so a rollback escapes the
                                 # divergence instead of re-entering it
-                                ckpt_mgr.save(self, epoch, nbatch + 1,
-                                              metric=eval_metric)
+                                with _obs_trace.span("checkpoint",
+                                                     dispatch=disp_id,
+                                                     epoch=epoch,
+                                                     nbatch=nbatch + 1):
+                                    ckpt_mgr.save(self, epoch, nbatch + 1,
+                                                  metric=eval_metric)
                                 since_ckpt = 0
                         self._check_worker_health(
                             ckpt_mgr, eval_metric, epoch, nbatch,
@@ -586,6 +623,9 @@ class BaseModule(object):
                     # reset and re-fast-forwarded like a resume). Dispatches
                     # still in the pipeline cover post-divergence state:
                     # their sums must never reach the metric or the guard
+                    _obs_trace.instant("divergence", epoch=epoch,
+                                       nbatch=nbatch,
+                                       reason=guard.diverged_reason)
                     pipeline.discard()
                     resume_state = self._guard_rollback(guard, ckpt_mgr)
                     epoch = resume_state.epoch
@@ -637,7 +677,9 @@ class BaseModule(object):
                     # never shed by back-pressure), then fit blocks until
                     # the epoch's state is durably on disk
                     ckpt_mgr.drain()
-                    ckpt_mgr.save(self, epoch + 1, 0)
+                    with _obs_trace.span("checkpoint", epoch=epoch + 1,
+                                         nbatch=0):
+                        ckpt_mgr.save(self, epoch + 1, 0)
                     ckpt_mgr.drain()
                 if train_iter is train_data or epoch < num_epoch - 1:
                     train_iter.reset()
@@ -679,26 +721,36 @@ class BaseModule(object):
         :class:`~mxnet_tpu.guard.TrainingDivergedError` when the rollback
         budget is exhausted or there is nothing safe to roll back to."""
         from ..guard import TrainingDivergedError
+        from ..obs import flight as _flight
+
+        def _diverged(msg):
+            # the post-mortem (docs/observability.md): the last K
+            # dispatches' spans + counter deltas land on disk BEFORE the
+            # error unwinds — dump() never raises into this failure path
+            _flight.dump("TrainingDivergedError: %s" % msg,
+                         extra={"health": guard.health.report()})
+            return TrainingDivergedError(msg, health=guard.health)
+
         if guard.health.rollbacks >= guard.max_rollbacks:
-            raise TrainingDivergedError(
+            raise _diverged(
                 "training diverged again after %d rollback(s) "
                 "(max_rollbacks=%d): %s"
                 % (guard.health.rollbacks, guard.max_rollbacks,
-                   guard.diverged_reason), health=guard.health)
+                   guard.diverged_reason))
         if ckpt_mgr is None:
-            raise TrainingDivergedError(
+            raise _diverged(
                 "training diverged (%s) and fit() has no checkpoint_prefix "
                 "to roll back to — configure checkpoints or lower the lr"
-                % (guard.diverged_reason,), health=guard.health)
+                % (guard.diverged_reason,))
         # async saves: the rollback target search must see the newest save
         # fully on disk (manifest + latest), not race a half-written one
         ckpt_mgr.drain()
         st = ckpt_mgr.load_latest()
         if st is None:
-            raise TrainingDivergedError(
+            raise _diverged(
                 "training diverged (%s) and no known-good checkpoint "
                 "exists under %r" % (guard.diverged_reason,
-                                     ckpt_mgr.prefix), health=guard.health)
+                                     ckpt_mgr.prefix))
         self.logger.warning(
             "TrainingGuard: rolling back to known-good checkpoint %s "
             "(epoch %d, %d batches done), reducing lr by x%g",
@@ -711,6 +763,15 @@ class BaseModule(object):
         self._drop_fused_state()
         self._apply_resume_state(st)
         self._scale_lr(guard.lr_factor)
+        # a SURVIVED divergence still leaves a post-mortem: the timeline
+        # that led into the rollback is exactly what the next tuning pass
+        # needs, and a rerun would not reproduce it (captured BEFORE
+        # note_rollback clears diverged_reason)
+        _flight.dump("guard rollback to %s (%s)"
+                     % (st.tag, guard.diverged_reason),
+                     extra={"health": guard.health.report(),
+                            "rollback_tag": st.tag,
+                            "rollback_epoch": st.epoch})
         guard.note_rollback(st.tag)
         return st
 

@@ -736,6 +736,8 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return False
+        from .. import profiler as _profiler
+        _profiler.maybe_autostart()
         if self._fused is None:
             if not self._fused_eligible():
                 return False
@@ -821,6 +823,8 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return None
+        from .. import profiler as _profiler
+        _profiler.maybe_autostart()
         if self._fused is None:
             if not self._fused_eligible():
                 return None

@@ -1,0 +1,41 @@
+"""``mxnet_tpu.obs`` — unified observability (docs/observability.md).
+
+Three legs over one substrate:
+
+1. **Host-span tracer** (:mod:`.trace`): ``obs.span("h2d", dispatch=i)``
+   context manager + instant events emitting Chrome trace-event JSON that
+   opens in Perfetto beside ``jax.profiler``'s device trace. Correlation
+   IDs (dispatch index, serving request id) ride the span args end to
+   end. ``MXTPU_TRACE=1`` arms it; off is a module-flag no-op.
+2. **Metrics registry** (:mod:`.registry`): typed counters / gauges /
+   histograms plus VIEWS over the five legacy process-global counter
+   objects (``io.DATA_HEALTH``, ``guard.TRAINING_HEALTH``,
+   ``serving.SERVING_HEALTH``, ``data.PIPELINE_STATS``,
+   ``tracecheck.RETRACE_EVENTS``) — one ``snapshot()``, one Prometheus
+   textfile export, one windowed-delta mechanism (:class:`.registry.
+   Window`) behind every Speedometer suffix.
+3. **Flight recorder** (:mod:`.flight`): bounded ring of recent spans +
+   per-dispatch counter deltas, dumped atomically on divergence /
+   rollback / worker loss / replica death, so a dead run's last-K-dispatch
+   timeline exists WITHOUT a rerun.
+"""
+from __future__ import annotations
+
+from . import flight, registry, trace
+from .flight import FLIGHT, FlightRecorder
+from .registry import (REGISTRY, Counter, Gauge, Histogram, Registry,
+                       Window, register_default_views)
+from .trace import complete, enabled, events, instant, save, span, start, stop
+
+__all__ = [
+    "trace", "registry", "flight",
+    "span", "instant", "complete", "enabled", "start", "stop", "save",
+    "events",
+    "Registry", "REGISTRY", "Counter", "Gauge", "Histogram", "Window",
+    "register_default_views",
+    "FlightRecorder", "FLIGHT",
+]
+
+# the five legacy health/stats objects become registry views at import —
+# lazily bound, so importing obs alone does not drag the training stack in
+register_default_views()

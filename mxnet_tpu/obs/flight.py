@@ -1,0 +1,178 @@
+"""Crash flight recorder: the last K dispatches' timeline, on disk,
+without a rerun.
+
+A bounded ring of recent host spans (fed by :mod:`.trace` through its
+sink hook) plus a ring of per-dispatch counter deltas (fed by ``fit``'s
+retirement path through :meth:`FlightRecorder.note`), dumped atomically
+to a post-mortem JSON when the run dies:
+
+- ``TrainingDivergedError`` / a guard rollback (module layer)
+- ``WorkerLostError`` (kvstore health escalation)
+- a serving replica death (fleet router) / batcher-thread death /
+  decode-loop death
+- fatal teardown (explicit :func:`dump` from the failing path)
+
+The dump never raises into the failure path it is recording: every step
+is wrapped, and the write rides PR 2's ``model.atomic_write_bytes`` so a
+crash mid-dump leaves either the previous dump or nothing — never a torn
+file.
+
+Knobs: ``MXTPU_FLIGHT_RECORDER`` (default ON — set ``0`` to disable and
+make ``obs.span`` a pure no-op when tracing is off too),
+``MXTPU_FLIGHT_RECORDER_PATH`` (default ``mxtpu_flight.json``),
+``MXTPU_FLIGHT_RECORDER_RING`` (span ring length, default 1024).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from ..base import env_int, env_str
+from . import trace as _trace
+from .registry import REGISTRY
+
+__all__ = ["FlightRecorder", "FLIGHT", "dump", "note", "enabled"]
+
+
+def _default_enabled():
+    import os as _os
+    return _os.environ.get("MXTPU_FLIGHT_RECORDER", "1").strip().lower() \
+        not in ("", "0", "false", "off", "no")
+
+
+class FlightRecorder(object):
+    """Bounded in-memory recorder + atomic post-mortem dumper."""
+
+    def __init__(self, ring=None, registry=None):
+        self._lock = threading.Lock()
+        n = ring if ring is not None \
+            else env_int("MXTPU_FLIGHT_RECORDER_RING", 1024)
+        self._spans = deque(maxlen=max(16, int(n)))
+        self._marks = deque(maxlen=256)
+        self._registry = registry or REGISTRY
+        self._window = None
+        self.dumps = 0          # dumps written (tests / CI)
+        self.last_dump_path = None
+        self.last_dump = None   # the last dump document (post-mortem in
+        #                         tests without re-reading the file)
+
+    # -- feeding -------------------------------------------------------
+    def on_event(self, ev):
+        """Trace-sink hook: every finished span/instant lands here."""
+        with self._lock:
+            self._spans.append(ev)
+
+    def note(self, marker, **ids):
+        """Capture the registry's counter delta since the previous note
+        into the marks ring (fit calls this per retired dispatch with
+        ``dispatch=i``; the serving tier per dispatched batch). Never
+        raises."""
+        try:
+            with self._lock:
+                if self._window is None:
+                    self._window = self._registry.window()
+                    delta = {}
+                else:
+                    delta = {k: v for k, v in self._window.delta().items()
+                             if isinstance(v, (int, float))
+                             and not isinstance(v, bool) and v}
+                self._marks.append({"marker": marker, "t": time.time(),
+                                    **ids, "delta": delta})
+        except Exception:
+            pass
+
+    # -- dumping -------------------------------------------------------
+    def path(self):
+        return env_str("MXTPU_FLIGHT_RECORDER_PATH", "mxtpu_flight.json")
+
+    def dump(self, reason, path=None, extra=None):
+        """Write the post-mortem JSON; returns the path, or None when
+        disabled or the write failed (logged, never raised — this runs
+        INSIDE failure paths)."""
+        if not enabled():
+            return None
+        try:
+            with self._lock:
+                spans = list(self._spans)
+                marks = list(self._marks)
+            try:
+                counters = self._registry.snapshot()
+            except Exception:
+                counters = {}
+            doc = {
+                "reason": str(reason),
+                "time": time.time(),
+                "pid": os.getpid(),
+                "spans": spans,
+                "counter_deltas": marks,
+                "counters": counters,
+            }
+            if extra:
+                try:
+                    json.dumps(extra)
+                    doc["extra"] = extra
+                except Exception:
+                    doc["extra"] = {"unserializable": repr(extra)}
+            from ..model import atomic_write_bytes
+            path = path or self.path()
+            atomic_write_bytes(path, json.dumps(doc).encode("utf-8"))
+            with self._lock:
+                self.dumps += 1
+                self.last_dump_path = path
+                self.last_dump = doc
+            import logging
+            logging.getLogger("mxnet_tpu").warning(
+                "obs: flight recorder dumped %d span(s) to %s (%s)",
+                len(spans), path, reason)
+            return path
+        except Exception:
+            import logging
+            logging.getLogger("mxnet_tpu").exception(
+                "obs: flight-recorder dump failed (continuing)")
+            return None
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self._marks.clear()
+            self._window = None
+            self.dumps = 0
+            self.last_dump_path = None
+            self.last_dump = None
+
+
+#: the process flight recorder (armed at import unless
+#: MXTPU_FLIGHT_RECORDER=0)
+FLIGHT = FlightRecorder()
+
+_enabled = _default_enabled()
+
+
+def enabled():
+    return _enabled
+
+
+def set_enabled(on):
+    """Arm/disarm at runtime (tests; operators use the env var). Also
+    attaches/detaches the trace sink so ``obs.span`` returns to the pure
+    no-op fast path when both tracing and recording are off."""
+    global _enabled
+    _enabled = bool(on)
+    _trace.set_sink(FLIGHT.on_event if _enabled else None)
+
+
+def dump(reason, path=None, extra=None):
+    """Module-level shorthand: ``FLIGHT.dump(...)``."""
+    return FLIGHT.dump(reason, path=path, extra=extra)
+
+
+def note(marker, **ids):
+    if _enabled:
+        FLIGHT.note(marker, **ids)
+
+
+if _enabled:
+    _trace.set_sink(FLIGHT.on_event)

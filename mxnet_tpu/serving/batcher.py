@@ -32,6 +32,7 @@ hanging callers.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -40,7 +41,14 @@ import time
 import numpy as np
 
 from ..base import MXNetError, env_float, env_int
+from ..obs import trace as _obs
 from .health import ServingHealth, SERVING_HEALTH
+
+#: process-wide serving request-id sequence: the correlation key threaded
+#: through submit -> queue -> coalesce -> dispatch -> split host spans
+#: (docs/observability.md) — shared with the fleet router and decode loop
+#: so one id never names two requests
+REQUEST_IDS = itertools.count(1)
 
 #: how often a blocked ``wait()``/drain re-checks batching-thread liveness
 #: while sleeping toward the request's actual deadline (a dead thread is
@@ -113,13 +121,18 @@ class Settleable(object):
 
 
 class _Request(Settleable):
-    __slots__ = ("inputs", "n", "deadline", "dispatched")
+    __slots__ = ("inputs", "n", "deadline", "dispatched", "rid",
+                 "t_submit")
 
-    def __init__(self, inputs, n, deadline, on_done=None):
+    def __init__(self, inputs, n, deadline, on_done=None, rid=None):
         super().__init__(on_done=on_done)
         self.inputs = inputs
         self.n = n
         self.deadline = deadline
+        #: serving correlation id (docs/observability.md); every host
+        #: span of this request's lifecycle carries it as ``req=``
+        self.rid = rid if rid is not None else next(REQUEST_IDS)
+        self.t_submit = time.perf_counter()
         #: True once the batching thread has started executing this
         #: request's engine dispatch — the fleet router uses it to tell a
         #: safely-retryable request (never ran) from one that may have
@@ -234,11 +247,13 @@ class Batcher(object):
         req = self.submit(inputs, deadline_ms=deadline_ms)
         return self.wait(req)
 
-    def submit(self, inputs, deadline_ms=None, on_done=None):
+    def submit(self, inputs, deadline_ms=None, on_done=None, rid=None):
         """Enqueue without blocking on the result; returns the request
         handle for :meth:`wait`. ``on_done`` (if given) is called with the
         request exactly once, after it settles — fulfilled, failed, or
-        shed — from whichever thread settles it."""
+        shed — from whichever thread settles it. ``rid`` carries an
+        EXISTING correlation id (the fleet router threads its request's id
+        through every replica assignment); default is a fresh one."""
         from .. import faults as _faults
         if self._closed:
             raise ServingClosedError("batcher is closed")
@@ -280,7 +295,7 @@ class Batcher(object):
         deadline = time.monotonic() + (
             (deadline_ms / 1e3) if deadline_ms is not None
             else self.default_deadline)
-        req = _Request(host, n, deadline, on_done=on_done)
+        req = _Request(host, n, deadline, on_done=on_done, rid=rid)
         # the _closed re-check and the enqueue are ATOMIC against
         # close()'s final shed: without the lock a submit could pass the
         # check, lose the CPU, and enqueue after close() drained the
@@ -296,6 +311,7 @@ class Batcher(object):
                     "saturated; shed at the edge" % self._queue.maxsize)
                 self.health.record_dropped(err)
                 raise err
+        _obs.instant("serve_submit", req=req.rid, n=req.n)
         self.health.record_request()
         return req
 
@@ -347,9 +363,15 @@ class Batcher(object):
                     req.fail(ServingDeadlineError("expired in queue"))
                     self.health.record_expired(req.error)
                     continue
+                # "serve_queue": submit -> joined a dispatchable batch
+                # (the carry path counts its full wait, once)
+                _obs.async_complete("serve_queue",
+                                    time.perf_counter() - req.t_submit,
+                                    id=req.rid, req=req.rid)
                 batch = [req]
                 self._inflight = batch
                 total = req.n
+                t_coalesce = time.perf_counter()
                 flush_at = now + self.max_latency
                 while total < self.max_batch and not self._closed:
                     remaining = flush_at - time.monotonic()
@@ -365,8 +387,15 @@ class Batcher(object):
                     if total + nxt.n > self.max_batch:
                         self._carry = nxt
                         break
+                    _obs.async_complete(
+                        "serve_queue",
+                        time.perf_counter() - nxt.t_submit,
+                        id=nxt.rid, req=nxt.rid)
                     batch.append(nxt)
                     total += nxt.n
+                _obs.complete("serve_coalesce",
+                              time.perf_counter() - t_coalesce,
+                              reqs=[r.rid for r in batch], n=total)
                 if self._fault_site is not None:
                     from .. import faults as _faults
                     act = _faults.fire(self._fault_site)
@@ -389,6 +418,14 @@ class Batcher(object):
                     "batching thread died: %r — request shed" % (e,)))
             if inflight:
                 self.health.record_shed(len(inflight), e)
+            # post-mortem (docs/observability.md): the recent request
+            # spans + serving counters land on disk before the thread
+            # exits; dump() never raises into this failure path
+            from ..obs import flight as _flight
+            _flight.dump(
+                "serving batcher thread died: %r" % (e,),
+                extra={"health": self.health.report(),
+                       "inflight": [r.rid for r in inflight]})
 
     def _dispatch(self, batch, total):
         names = self.engine._input_names
@@ -402,7 +439,9 @@ class Batcher(object):
             # death must FAIL them, not silently retry them elsewhere
             for r in batch:
                 r.dispatched = True
-            outs = self.engine.infer(stacked)
+            with _obs.span("serve_dispatch", reqs=[r.rid for r in batch],
+                           n=total):
+                outs = self.engine.infer(stacked)
         except Exception as e:
             for r in batch:
                 r.fail(e)
@@ -410,6 +449,7 @@ class Batcher(object):
             return
         # split result rows back per request (outputs may carry a
         # rows-per-example factor, e.g. the LM's (batch*seq, vocab) head)
+        t_split = time.perf_counter()
         offset = 0
         for r in batch:
             rows = []
@@ -420,3 +460,5 @@ class Batcher(object):
                     rows.append(o)
             r.fulfill(rows)
             offset += r.n
+        _obs.complete("serve_split", time.perf_counter() - t_split,
+                      reqs=[r.rid for r in batch])

@@ -37,7 +37,8 @@ import time
 import numpy as np
 
 from ..base import MXNetError
-from .batcher import ServingClosedError
+from ..obs import trace as _obs
+from .batcher import REQUEST_IDS, ServingClosedError
 from .health import ServingHealth, SERVING_HEALTH
 
 
@@ -118,7 +119,8 @@ def _build_decode_fn(num_layers, num_heads, mesh=None):
 class GenerateFuture(object):
     """Handle for one in-flight sequence; :meth:`result` blocks."""
 
-    __slots__ = ("prompt", "max_new", "event", "tokens", "error", "_loop")
+    __slots__ = ("prompt", "max_new", "event", "tokens", "error", "_loop",
+                 "rid")
 
     def __init__(self, loop, prompt, max_new):
         self.prompt = list(prompt)
@@ -127,6 +129,10 @@ class GenerateFuture(object):
         self.tokens = None
         self.error = None
         self._loop = loop
+        #: serving correlation id (docs/observability.md): shares the
+        #: batcher's process-wide sequence so fleet + decode spans never
+        #: collide on an id
+        self.rid = next(REQUEST_IDS)
 
     def done(self):
         return self.event.is_set()
@@ -276,6 +282,7 @@ class DecodeLoop(object):
         self._slots = [None] * self.slots
         self._closed = False
         self.dead = None
+        self._steps = 0   # decode-step ordinal for the host trace
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._run,
                                         name="mxtpu-serve-decode",
@@ -332,6 +339,8 @@ class DecodeLoop(object):
         fut = GenerateFuture(self, prompt, max_new_tokens)
         self._join_q.put(fut)
         self._wake.set()
+        _obs.instant("decode_submit", req=fut.rid, prompt_len=len(prompt),
+                     max_new=int(max_new_tokens))
         self.health.record_request()
         return fut
 
@@ -371,6 +380,7 @@ class DecodeLoop(object):
             except queue.Empty:
                 return
             self._slots[i] = _Slot(fut)
+            _obs.instant("decode_join", req=fut.rid, slot=i)
             self.health.record_join()
 
     def _run(self):
@@ -391,10 +401,22 @@ class DecodeLoop(object):
             self.dead = e
             self._shed(ServingClosedError(
                 "decode loop died: %r — request shed" % (e,)))
+            # post-mortem before the thread exits (docs/observability.md);
+            # dump() never raises into this failure path
+            from ..obs import flight as _flight
+            _flight.dump("decode loop died: %r" % (e,),
+                         extra={"health": self.health.report()})
             return
 
     def _step(self):
         import jax.numpy as jnp
+        self._steps += 1
+        with _obs.span("decode_step", step=self._steps,
+                       reqs=[s.fut.rid for s in self._slots
+                             if s is not None]):
+            self._step_inner(jnp)
+
+    def _step_inner(self, jnp):
         tokens = np.zeros(self.slots, np.int32)
         pos = np.zeros(self.slots, np.int32)
         for i, slot in enumerate(self._slots):
@@ -437,6 +459,8 @@ class DecodeLoop(object):
         self._slots[i] = None
         slot.fut.tokens = list(slot.emitted)
         slot.fut.event.set()
+        _obs.instant("decode_retire", req=slot.fut.rid, slot=i,
+                     emitted=len(slot.fut.tokens))
         self.health.record_retire()
 
     # ------------------------------------------------------------------

@@ -43,7 +43,8 @@ from collections import deque
 import numpy as np
 
 from ..base import MXNetError, env_float, env_int
-from .batcher import (Batcher, Settleable, ServingClosedError,
+from ..obs import trace as _obs
+from .batcher import (Batcher, REQUEST_IDS, Settleable, ServingClosedError,
                       ServingDeadlineError, ServingOverloadedError)
 from .health import ServingHealth, SERVING_HEALTH
 
@@ -78,7 +79,7 @@ class FleetRequest(Settleable):
     with the batcher's request via :class:`~.batcher.Settleable`."""
 
     __slots__ = ("inputs", "n", "priority", "deadline", "requeues",
-                 "_health")
+                 "_health", "rid")
 
     def __init__(self, inputs, n, priority, deadline, on_done=None,
                  health=None):
@@ -89,6 +90,10 @@ class FleetRequest(Settleable):
         self.deadline = deadline
         self.requeues = 0
         self._health = health    # this request's class ServingHealth
+        #: serving correlation id (docs/observability.md) — threaded into
+        #: every replica assignment, so one request's spans share one id
+        #: across the router and whichever batcher(s) it rides
+        self.rid = next(REQUEST_IDS)
 
     def result(self, timeout=None):
         """Block until served (or failed); returns the engine output list
@@ -360,6 +365,7 @@ class FleetRouter(object):
                 ch.record_dropped(err)
                 raise err
             q.append(freq)
+        _obs.instant("fleet_submit", req=freq.rid, priority=priority, n=n)
         ch.record_request()
         self._work.set()
         return freq
@@ -415,6 +421,15 @@ class FleetRouter(object):
                 "replica batching thread died")
         logging.warning("FleetRouter: replica %r died (%r) — re-queueing "
                         "its undispatched requests", rep.name, rep.died)
+        # post-mortem (docs/observability.md): the replica's recent
+        # request spans + the fleet's counters, on disk before recovery
+        # re-queues a single request; dump() never raises
+        from ..obs import flight as _flight
+        _obs.instant("replica_death", replica=rep.name,
+                     error=repr(rep.died))
+        _flight.dump("fleet replica %r died: %r" % (rep.name, rep.died),
+                     extra={"replica": rep.name,
+                            "report": rep.report()})
         # queued-but-undispatched: safe to serve elsewhere (in-flight
         # dispatched requests were already failed by the dying thread,
         # or settle through on_done as shed — those may have side-effected
@@ -547,7 +562,9 @@ class FleetRouter(object):
                         rep.assigned += 1
                     rep.batcher.submit(freq.inputs,
                                        deadline_ms=remaining_ms,
-                                       on_done=hook)
+                                       on_done=hook, rid=freq.rid)
+                    _obs.instant("fleet_assign", req=freq.rid,
+                                 replica=rep.name)
                     assigned = True
                     break
                 except ServingOverloadedError:

@@ -13,6 +13,14 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# flight-recorder post-mortems (docs/observability.md) default to the
+# CWD in production; a test run triggers dozens of deliberate failure
+# paths and must not litter the repo root with mxtpu_flight.json
+if "MXTPU_FLIGHT_RECORDER_PATH" not in os.environ:
+    import tempfile
+    os.environ["MXTPU_FLIGHT_RECORDER_PATH"] = os.path.join(
+        tempfile.mkdtemp(prefix="mxtpu_flight_"), "mxtpu_flight.json")
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 

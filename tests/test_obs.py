@@ -1,0 +1,607 @@
+"""Unified observability tests (docs/observability.md): the host-span
+tracer (Chrome trace-event JSON, correlation IDs, near-zero-cost off
+mode), the one metrics registry (typed instruments + views over the five
+legacy health/stats objects, Prometheus export, windowed deltas), the
+crash flight recorder (ring bounds, atomic never-raising dumps, the
+guard-divergence and fleet-replica-death triggers via faults.py), the
+deferred profiler autostart, and the Speedometer suffix consolidation
+onto ``obs.registry.Window``.
+"""
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import faults, guard as guard_mod, obs, serving, sym  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.guard import TrainingGuard, TrainingDivergedError  # noqa: E402
+from mxnet_tpu.obs import flight as obs_flight  # noqa: E402
+from mxnet_tpu.obs import registry as obs_registry  # noqa: E402
+from mxnet_tpu.obs import trace as obs_trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(tmp_path, monkeypatch):
+    """Each test starts with the tracer off+empty, the flight recorder
+    empty and dumping into a throwaway path, and no armed faults."""
+    faults.clear()
+    obs_trace.stop()
+    obs_trace.clear()
+    obs_flight.FLIGHT.clear()
+    guard_mod.TRAINING_HEALTH.reset()
+    monkeypatch.setenv("MXTPU_FLIGHT_RECORDER_PATH",
+                       str(tmp_path / "flight.json"))
+    yield
+    faults.clear()
+    obs_trace.stop()
+    obs_trace.clear()
+    obs_flight.FLIGHT.clear()
+    guard_mod.TRAINING_HEALTH.reset()
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+def test_span_off_is_shared_noop():
+    """Tracing+recording off: span() returns ONE shared no-op instance —
+    no allocation on the hot path — and records nothing."""
+    was = obs_flight.enabled()
+    obs_flight.set_enabled(False)
+    try:
+        a = obs_trace.span("x", dispatch=1)
+        b = obs_trace.span("y")
+        assert a is b is obs_trace._NOOP
+        with a:
+            pass
+        obs_trace.complete("z", 0.1)
+        obs_trace.instant("w")
+        obs_trace.async_complete("v", 0.1, id=1)
+        assert obs_trace.events() == []
+    finally:
+        obs_flight.set_enabled(was)
+
+
+def test_span_records_args_nesting_and_thread_metadata():
+    obs_trace.start()
+    with obs_trace.span("outer", dispatch=3):
+        with obs_trace.span("inner", dispatch=3, k=4):
+            time.sleep(0.001)
+    evs = obs_trace.events()
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta and meta[0]["name"] == "thread_name"
+    spans = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert spans["inner"]["args"] == {"dispatch": 3, "k": 4}
+    assert spans["outer"]["args"] == {"dispatch": 3}
+    # inner nests inside outer on the same track
+    o, i = spans["outer"], spans["inner"]
+    assert o["ts"] <= i["ts"]
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"]
+    assert obs_trace.nest_check(evs) == []
+
+
+def test_span_exception_annotates_and_propagates():
+    obs_trace.start()
+    with pytest.raises(ValueError):
+        with obs_trace.span("bad"):
+            raise ValueError("boom")
+    ev = [e for e in obs_trace.events() if e["ph"] == "X"][0]
+    assert ev["args"]["error"] == "ValueError"
+
+
+def test_complete_backdates_and_instant_marks():
+    obs_trace.start()
+    obs_trace.complete("measured", 0.05, dispatch=7)
+    obs_trace.instant("mark", req=9)
+    evs = obs_trace.events()
+    comp = [e for e in evs if e["name"] == "measured"][0]
+    inst = [e for e in evs if e["name"] == "mark"][0]
+    assert comp["ph"] == "X" and comp["dur"] >= 49000  # ~50ms in us
+    assert comp["args"]["dispatch"] == 7
+    assert inst["ph"] == "i" and inst["args"]["req"] == 9
+
+
+def test_async_complete_emits_begin_end_pair():
+    obs_trace.start()
+    obs_trace.async_complete("serve_queue", 0.02, id=42, req=42)
+    b, e = [ev for ev in obs_trace.events() if ev["ph"] in ("b", "e")]
+    assert b["ph"] == "b" and e["ph"] == "e"
+    assert b["id"] == e["id"] == 42
+    assert e["ts"] - b["ts"] >= 19000
+
+
+def test_save_writes_perfetto_loadable_chrome_json(tmp_path):
+    obs_trace.start()
+    with obs_trace.span("s", dispatch=0):
+        pass
+    p = obs_trace.save(str(tmp_path / "t.json"))
+    doc = json.load(open(p))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        assert "ph" in ev and "pid" in ev and "tid" in ev
+        if ev["ph"] == "X":
+            assert "ts" in ev and "dur" in ev
+    assert doc["otherData"]["dropped_events"] == 0
+
+
+def test_trace_buffer_is_bounded(monkeypatch):
+    monkeypatch.setattr(obs_trace, "_MAX_EVENTS", 10)
+    obs_trace.start()
+    for i in range(50):
+        obs_trace.instant("e%d" % i)
+    assert len(obs_trace.events()) <= 10
+    p = obs_trace.save()
+    try:
+        assert json.load(open(p))["otherData"]["dropped_events"] > 0
+    finally:
+        os.unlink(p)
+
+
+def test_spans_from_many_threads_all_land():
+    obs_trace.start()
+
+    def work(n):
+        for i in range(20):
+            with obs_trace.span("t%d" % n, i=i):
+                pass
+
+    ts = [threading.Thread(target=work, args=(n,)) for n in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    spans = [e for e in obs_trace.events() if e["ph"] == "X"]
+    assert len(spans) == 80
+    assert obs_trace.nest_check(obs_trace.events()) == []
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_typed_instruments():
+    reg = obs_registry.Registry()
+    c = reg.counter("req_total", "requests")
+    g = reg.gauge("queue_depth")
+    h = reg.histogram("latency_s")
+    c.inc()
+    c.inc(4)
+    g.set(7)
+    h.observe(0.5)
+    h.observe(1.5)
+    snap = reg.snapshot()
+    assert snap["req_total"] == 5
+    assert snap["queue_depth"] == 7.0
+    assert snap["latency_s_count"] == 2
+    assert snap["latency_s_sum"] == 2.0
+    assert snap["latency_s_min"] == 0.5 and snap["latency_s_max"] == 1.5
+    with pytest.raises(MXNetError, match="must be >= 0"):
+        c.inc(-1)
+    # idempotent re-get; kind conflict raises
+    assert reg.counter("req_total") is c
+    with pytest.raises(MXNetError, match="already registered"):
+        reg.gauge("req_total")
+
+
+def test_registry_snapshot_carries_every_legacy_health_key():
+    """The five legacy process-global objects are registry views: every
+    key of every report() appears in ONE flat snapshot — the back-compat
+    mirrors stay untouched."""
+    from mxnet_tpu import io as mxio, tracecheck
+    from mxnet_tpu.data import stats as dstats
+    from mxnet_tpu.serving import health as shealth
+    snap = obs.REGISTRY.snapshot()
+    expect = {
+        "data_health": mxio.DATA_HEALTH.report(),
+        "training_health": guard_mod.TRAINING_HEALTH.report(),
+        "serving_health": shealth.SERVING_HEALTH.report(),
+        "pipeline_stats": dstats.PIPELINE_STATS.report(),
+        "retrace_events": {"count": tracecheck.retrace_count()},
+    }
+    for view, rep in expect.items():
+        for key in rep:
+            assert "%s.%s" % (view, key) in snap, (view, key)
+
+
+def test_registry_view_error_does_not_break_snapshot():
+    reg = obs_registry.Registry()
+    reg.counter("ok").inc()
+    reg.register_view("bad", lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert snap["ok"] == 1
+    assert "ZeroDivisionError" in snap["bad.error"]
+
+
+def test_prometheus_export_numeric_and_mangled():
+    reg = obs_registry.Registry()
+    reg.counter("serve.requests").inc(3)
+    reg.register_view("v", lambda: {"x": 1.5, "last_error": "nope"})
+    text = reg.to_prometheus()
+    assert "serve_requests 3" in text
+    assert "v_x 1.5" in text
+    assert "nope" not in text           # strings never exported
+    assert "# TYPE serve_requests counter" in text
+
+
+def test_window_delta_peek_rebase_and_keying():
+    vals = {"a": 0, "s": "str"}
+    w = obs_registry.Window(lambda: dict(vals))
+    vals["a"] = 5
+    assert w.delta() == {"a": 5, "s": "str"}
+    vals["a"] = 7
+    assert w.peek() == {"a": 2, "s": "str"}   # peek does NOT advance
+    assert w.delta() == {"a": 2, "s": "str"}
+    w.rebase()
+    assert w.delta() == {"a": 0, "s": "str"}
+    # keyed window refuses a foreign source without touching the baseline
+    key = object()
+    wk = obs_registry.Window(lambda: dict(vals), key=key)
+    vals["a"] = 17
+    assert wk.delta(object()) is None
+    assert wk.delta(key) == {"a": 10, "s": "str"}
+    with pytest.raises(MXNetError, match="callable"):
+        obs_registry.Window(42)
+
+
+def test_registry_window_over_global_views():
+    w = obs.REGISTRY.window()
+    guard_mod.TRAINING_HEALTH.record_steps(4, 1)
+    d = w.delta()
+    assert d["training_health.steps"] == 4
+    assert d["training_health.skipped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Speedometer consolidation (one Window mechanism behind every suffix)
+# ---------------------------------------------------------------------------
+
+def _bep(nbatch, locals_):
+    from mxnet_tpu.module.base_module import BatchEndParam
+    return BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                         locals=locals_)
+
+
+def test_speedometer_interleaved_pipelines_keep_separate_baselines(caplog):
+    """Two pipelined runs alternating on ONE Speedometer each report only
+    their own window — the per-source Window keying makes cross-charging
+    impossible (the stronger form of the PR 4 interleave fix)."""
+    from mxnet_tpu.callback import Speedometer
+
+    class _P(object):
+        def __init__(self):
+            self.depth = 2
+            self.host_stall = 0.0
+
+    p1, p2 = _P(), _P()
+    sp = Speedometer(batch_size=16, frequent=4)
+    with caplog.at_level(logging.INFO):
+        sp(_bep(1, {"pipeline": p1}))       # init: baselines p1 at 0
+        p1.host_stall += 1.0
+        p2.host_stall += 9.0                # p2 accumulates elsewhere
+        sp(_bep(5, {"pipeline": p1}))       # fire: p1 window = 1.0
+        sp(_bep(0, {"pipeline": p2}))       # re-init on p2's stream
+        p2.host_stall += 0.5
+        sp(_bep(5, {"pipeline": p2}))       # fire: p2 window = 0.5, NOT 9.5
+    piped = [r.getMessage() for r in caplog.records
+             if "Pipeline:" in r.getMessage()]
+    assert "host_stall=1.000s" in piped[0], piped
+    assert "host_stall=0.500s" in piped[1], piped
+
+
+def test_speedometer_data_suffix_windows_per_source(caplog):
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.data.stats import PipelineStats
+
+    st = PipelineStats()
+    sp = Speedometer(batch_size=16, frequent=4)
+    with caplog.at_level(logging.INFO):
+        st.add("stall", 2.0)
+        sp(_bep(1, {"data_stats": st}))     # init: baseline at 2.0
+        st.add("stall", 0.25)
+        sp(_bep(5, {"data_stats": st}))     # fire: window = 0.25
+    lines = [r.getMessage() for r in caplog.records
+             if "Data:" in r.getMessage()]
+    assert lines and "stall=0.250s" in lines[0], lines
+
+
+def test_speedometer_windows_share_one_mechanism():
+    """The consolidation claim itself: every windowed suffix's state is an
+    obs.registry.Window in ONE store — no per-suffix baseline attributes
+    left to drift."""
+    from mxnet_tpu.callback import Speedometer
+
+    class _P(object):
+        depth = 1
+        host_stall = 0.0
+
+    sp = Speedometer(batch_size=1, frequent=10)
+    sp(_bep(1, {"pipeline": _P()}))
+    assert sp._windows, "suffixes must register Windows"
+    for _wr, w in sp._windows.values():
+        assert isinstance(w, obs_registry.Window)
+    for legacy in ("_stall_seen", "_data_stall_seen", "_retrace_base"):
+        assert not hasattr(sp, legacy), legacy
+
+
+def test_speedometer_window_store_does_not_retain_dead_runs():
+    """A long-lived Speedometer reused across many runs must not pin each
+    dead run's pipeline/stats objects: sources are held weakly and dead
+    entries are pruned."""
+    import gc
+    import weakref
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.module.base_module import _DispatchPipeline
+
+    sp = Speedometer(batch_size=1, frequent=10)
+    p = _DispatchPipeline(2)    # the REAL (slots) pipeline class
+    sp(_bep(1, {"pipeline": p}))
+    ref = weakref.ref(p)
+    del p
+    gc.collect()
+    assert ref() is None, "Speedometer must not keep the pipeline alive"
+    # the next interaction prunes the dead entry
+    sp(_bep(2, {"pipeline": _DispatchPipeline(1)}))
+    assert len([k for k in sp._windows if k[0] == "pipeline"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_is_bounded_and_fed_by_spans():
+    rec = obs_flight.FlightRecorder(ring=16)
+    for i in range(100):
+        rec.on_event({"ph": "X", "name": "s%d" % i})
+    assert len(rec._spans) == 16
+    assert rec._spans[-1]["name"] == "s99"
+
+
+def test_flight_note_captures_registry_deltas():
+    reg = obs_registry.Registry()
+    c = reg.counter("x")
+    rec = obs_flight.FlightRecorder(ring=16, registry=reg)
+    rec.note("dispatch_retired", dispatch=0)   # first note: baseline
+    c.inc(3)
+    rec.note("dispatch_retired", dispatch=1)
+    marks = list(rec._marks)
+    assert marks[0]["dispatch"] == 0 and marks[0]["delta"] == {}
+    assert marks[1]["dispatch"] == 1 and marks[1]["delta"] == {"x": 3}
+
+
+def test_flight_dump_atomic_and_contains_spans_counters(tmp_path):
+    obs_trace.start()
+    with obs_trace.span("dispatch", dispatch=5):
+        pass
+    obs_flight.note("dispatch_retired", dispatch=5)
+    p = obs_flight.dump("unit test", path=str(tmp_path / "d.json"))
+    doc = json.load(open(p))
+    assert doc["reason"] == "unit test"
+    assert any(ev.get("name") == "dispatch" for ev in doc["spans"])
+    assert any(m.get("dispatch") == 5 for m in doc["counter_deltas"])
+    assert "training_health.skipped" in doc["counters"]
+    assert obs_flight.FLIGHT.last_dump_path == p
+
+
+def test_flight_dump_never_raises(monkeypatch, tmp_path):
+    """The dump runs INSIDE failure paths: a broken write (or an
+    unserializable extra) must degrade to a logged warning, never a
+    second exception."""
+    import mxnet_tpu.model as model
+    monkeypatch.setattr(model, "atomic_write_bytes",
+                        lambda *a, **k: 1 / 0)
+    assert obs_flight.dump("broken") is None
+    monkeypatch.undo()
+    p = obs_flight.dump("odd extra", path=str(tmp_path / "e.json"),
+                        extra={"bad": object()})
+    assert "object" in json.load(open(p))["extra"]["unserializable"]
+
+
+def test_flight_disabled_skips_dump(monkeypatch):
+    was = obs_flight.enabled()
+    obs_flight.set_enabled(False)
+    try:
+        assert obs_flight.dump("nope") is None
+    finally:
+        obs_flight.set_enabled(was)
+
+
+# -- fault-injected triggers (the ISSUE's acceptance paths) -----------------
+
+def _guard_mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _toy_data(n=128, dim=10, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, classes)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.faults
+def test_injected_divergence_produces_flight_dump(tmp_path):
+    """ACCEPTANCE: an injected ``guard.grad_nan`` skip storm diverges the
+    run; the TrainingDivergedError path dumps a post-mortem containing
+    the correlated dispatch spans and the per-dispatch counter deltas —
+    and the dump lands even though fit() raises."""
+    obs_trace.start()
+    dump_path = str(tmp_path / "flight.json")
+    os.environ["MXTPU_FLIGHT_RECORDER_PATH"] = dump_path
+    X, y = _toy_data()
+    mx.random.seed(3)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_guard_mlp(), context=mx.cpu())
+    g = TrainingGuard(max_skips_per_window=2, window=50)
+    faults.inject("guard.grad_nan", nth=2, times=2)
+    with pytest.raises(TrainingDivergedError):
+        mod.fit(train, num_epoch=1, steps_per_dispatch=4, guard=g,
+                optimizer_params={"learning_rate": 0.1})
+    assert os.path.exists(dump_path)
+    doc = json.load(open(dump_path))
+    assert "TrainingDivergedError" in doc["reason"]
+    disp_spans = [ev for ev in doc["spans"]
+                  if ev.get("name") == "dispatch"]
+    assert disp_spans, "dump must carry the recent dispatch spans"
+    assert all("dispatch" in ev["args"] for ev in disp_spans)
+    retired = [m for m in doc["counter_deltas"]
+               if m.get("marker") == "dispatch_retired"]
+    assert retired, "dump must carry per-dispatch counter deltas"
+    # the skip storm is visible in the captured deltas
+    assert any(m["delta"].get("training_health.skipped")
+               for m in retired)
+    assert doc["extra"]["health"]["divergences"] == 1
+
+
+def _serve_engine():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(0)
+    params = {"arg:fc1_weight": rs.randn(4, 6).astype(np.float32),
+              "arg:fc1_bias": rs.randn(4).astype(np.float32)}
+    return serving.ServingEngine(net, params, {"data": (1, 6)},
+                                 buckets=(4, 8))
+
+
+@pytest.mark.faults
+def test_replica_death_produces_flight_dump(tmp_path):
+    """ACCEPTANCE: an injected ``fleet.replica_die`` produces a replica-
+    death post-mortem with the dead replica's report and recent serving
+    spans, while the fleet still recovers every request."""
+    obs_trace.start()
+    dump_path = str(tmp_path / "flight.json")
+    os.environ["MXTPU_FLIGHT_RECORDER_PATH"] = dump_path
+    router = serving.FleetRouter(
+        [serving.Batcher(_serve_engine(), max_latency_ms=2.0),
+         serving.Batcher(_serve_engine(), max_latency_ms=2.0)],
+        tick_ms=5.0)
+    try:
+        faults.inject("fleet.replica_die", nth=1, kind="die")
+        x = np.random.RandomState(1).randn(1, 1, 6).astype(np.float32)
+        reqs = [router.submit({"data": x}, deadline_ms=15000)
+                for _ in range(8)]
+        for r in reqs:
+            assert r.result(timeout=20.0)
+    finally:
+        router.close()
+    assert os.path.exists(dump_path)
+    doc = json.load(open(dump_path))
+    assert "died" in doc["reason"]
+    assert doc["extra"]["report"]["state"] == "dead"
+    names = {ev.get("name") for ev in doc["spans"]}
+    assert "fleet_submit" in names or "serve_dispatch" in names, names
+    assert "serving_health.requests" in doc["counters"]
+
+
+@pytest.mark.faults
+def test_batcher_death_dump_and_decode_death_dump(tmp_path):
+    obs_trace.start()
+    # batcher thread death
+    b = serving.Batcher(_serve_engine(), max_latency_ms=1.0,
+                        fault_site="fleet.replica_die")
+    faults.inject("fleet.replica_die", nth=1, kind="die")
+    req = b.submit({"data": np.zeros((1, 1, 6), np.float32)},
+                   deadline_ms=4000)
+    with pytest.raises(serving.ServingClosedError):
+        b.wait(req)
+    t0 = time.monotonic()
+    while obs_flight.FLIGHT.dumps < 1 and time.monotonic() - t0 < 5.0:
+        time.sleep(0.01)
+    assert obs_flight.FLIGHT.dumps >= 1
+    assert "batcher thread died" in obs_flight.FLIGHT.last_dump["reason"]
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+def test_profiler_autostart_deferred_to_first_dispatch(monkeypatch):
+    """MXNET_PROFILER_AUTOSTART no longer fires at import (where it would
+    race profiler_set_config): the pending flag resolves at the first
+    dispatch via maybe_autostart, AFTER set_config has pointed the trace
+    somewhere."""
+    from mxnet_tpu import profiler
+    calls = []
+    monkeypatch.setattr(profiler.jax.profiler, "start_trace",
+                        lambda d: calls.append(d))
+    monkeypatch.setattr(profiler.jax.profiler, "stop_trace", lambda: None)
+    monkeypatch.setattr(profiler, "_autostart_pending", True)
+    # config BEFORE the first dispatch: honored, because nothing started
+    profiler.profiler_set_config(filename="/tmp/late_config.json")
+    assert calls == []
+    profiler.maybe_autostart()
+    assert calls == ["/tmp/late_config_trace"]
+    profiler._state["running"] = False
+    # resolved: later dispatches are a no-op boolean check
+    profiler.maybe_autostart()
+    assert len(calls) == 1
+
+
+def test_profiler_scope_emits_host_span(monkeypatch):
+    from mxnet_tpu import profiler
+    obs_trace.start()
+
+    class _FakeAnnotation(object):
+        def __init__(self, name):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(profiler.jax.profiler, "TraceAnnotation",
+                        _FakeAnnotation)
+    with profiler.Scope("user_region", epoch=3):
+        pass
+    spans = [e for e in obs_trace.events() if e["ph"] == "X"]
+    assert spans and spans[0]["name"] == "user_region"
+    assert spans[0]["args"] == {"epoch": 3}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: fused fit + batcher serve under MXTPU_TRACE (the CI gate's
+# in-process twin)
+# ---------------------------------------------------------------------------
+
+def test_fused_fit_trace_correlates_stages_per_dispatch(tmp_path):
+    obs_trace.start()
+    X, y = _toy_data(64)
+    mx.random.seed(0)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_guard_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, steps_per_dispatch=2,
+            optimizer_params={"learning_rate": 0.1},
+            checkpoint_prefix=str(tmp_path / "ck"),
+            checkpoint_every_n_batches=4)
+    evs = obs_trace.events()
+    assert obs_trace.nest_check(evs) == []
+    by = {}
+    for e in evs:
+        if e["ph"] == "X":
+            by.setdefault(e["name"], []).append(e)
+    for stage in ("data_wait", "h2d", "superbatch_assemble", "dispatch",
+                  "readback_stall", "checkpoint"):
+        assert stage in by, (stage, sorted(by))
+    # correlation: every dispatch index that was dispatched also has an
+    # h2d and a readback with the SAME index
+    disp = {e["args"]["dispatch"] for e in by["dispatch"]}
+    h2d = {e["args"]["dispatch"] for e in by["h2d"]}
+    rb = {e["args"]["dispatch"] for e in by["readback_stall"]}
+    assert disp and disp <= h2d, (disp, h2d)
+    assert disp == rb
+    # 2 epochs x 64/(8*2) dispatches, monotonic ids
+    assert sorted(disp) == list(range(len(disp)))

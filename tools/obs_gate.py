@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""CI gate: the unified observability layer (docs/observability.md).
+
+Four checks, one process:
+
+1. **Trace schema over a fused fit.** A 2-epoch ``Module.fit
+   (steps_per_dispatch=2)`` under ``MXTPU_TRACE=1`` must emit a Chrome
+   trace-event JSON whose complete events nest properly per thread, that
+   carries every expected training stage (data_wait, h2d,
+   superbatch_assemble, dispatch, readback_stall, checkpoint), and whose
+   dispatch correlation IDs agree end to end: every dispatched index has
+   an h2d span and a readback_stall span with the SAME index.
+2. **Trace schema over a batcher serve run.** The request lifecycle
+   (serve_submit -> serve_queue -> serve_coalesce -> serve_dispatch ->
+   serve_split) must be present and id-consistent: every request id that
+   reached a dispatch was submitted.
+3. **Registry snapshot completeness.** ``obs.REGISTRY.snapshot()`` must
+   carry EVERY key of every legacy health/stats object's report() — the
+   five process-global counters are views, and a view falling off the
+   registry would silently blind the bench/flight-recorder exports.
+4. **Tracing-off cost A/B.** With tracing and the flight recorder off,
+   ``obs.span`` must be a shared-noop flag check: the gate measures the
+   per-call cost of the off path (bounded in microseconds) AND runs the
+   same small fit traced vs untraced, asserting the untraced run pays no
+   measurable per-dispatch cost (band ``MXTPU_OBS_AB_TOL``, default
+   1.5x — generous because a 1-core CI host is noisy; the real contract
+   is the microbenchmark).
+
+Exit nonzero on any violation, with the offending spans/keys named.
+"""
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np  # noqa: E402
+
+
+def _mlp():
+    from mxnet_tpu import sym
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=16,
+                             name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy(n=96, dim=10, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, classes)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return X, y
+
+
+def _fit_once(tmpdir, tag, k=2, epochs=2):
+    import mxnet_tpu as mx
+    X, y = _toy()
+    mx.random.seed(0)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(),
+                        logger=logging.getLogger("obs_gate"))
+    t0 = time.perf_counter()
+    mod.fit(it, num_epoch=epochs, steps_per_dispatch=k,
+            optimizer_params={"learning_rate": 0.1},
+            checkpoint_prefix=os.path.join(tmpdir, tag, "ck"),
+            checkpoint_every_n_batches=4)
+    return time.perf_counter() - t0
+
+
+def _fail(msg):
+    print("obs gate FAIL: %s" % msg)
+    sys.exit(1)
+
+
+def check_train_trace(tmpdir):
+    from mxnet_tpu import obs
+    obs.trace.clear()
+    obs.start()
+    _fit_once(tmpdir, "traced")
+    evs = obs.events()
+    obs.stop()
+    path = os.path.join(tmpdir, "train_trace.json")
+    obs.save(path)
+    doc = json.load(open(path))
+    if not doc.get("traceEvents"):
+        _fail("train trace has no events")
+    bad = obs.trace.nest_check(doc["traceEvents"])
+    if bad:
+        _fail("train trace nesting violations:\n  " + "\n  ".join(bad))
+    by = {}
+    for ev in evs:
+        if ev["ph"] == "X":
+            by.setdefault(ev["name"], []).append(ev)
+    for stage in ("data_wait", "h2d", "superbatch_assemble", "dispatch",
+                  "readback_stall", "checkpoint"):
+        if stage not in by:
+            _fail("train trace missing stage %r (have %s)"
+                  % (stage, sorted(by)))
+    disp = {e["args"]["dispatch"] for e in by["dispatch"]}
+    h2d = {e["args"]["dispatch"] for e in by["h2d"]}
+    rb = {e["args"]["dispatch"] for e in by["readback_stall"]}
+    if not disp:
+        _fail("no dispatch spans recorded")
+    if not disp <= h2d:
+        _fail("dispatch ids %s lack matching h2d spans %s"
+              % (sorted(disp - h2d), sorted(h2d)))
+    if disp != rb:
+        _fail("dispatch ids %s != readback ids %s"
+              % (sorted(disp), sorted(rb)))
+    print("obs gate: train trace ok — %d events, %d dispatches, "
+          "stages %s" % (len(doc["traceEvents"]), len(disp),
+                         ",".join(sorted(by))))
+
+
+def check_serve_trace(tmpdir):
+    import mxnet_tpu as mx
+    from mxnet_tpu import obs, serving
+    obs.trace.clear()
+    obs.start()
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc1"), name="softmax")
+    rs = np.random.RandomState(0)
+    params = {"arg:fc1_weight": rs.randn(4, 6).astype(np.float32),
+              "arg:fc1_bias": rs.randn(4).astype(np.float32)}
+    eng = serving.ServingEngine(net, params, {"data": (1, 6)},
+                                buckets=(4, 8))
+    b = serving.Batcher(eng, max_latency_ms=2.0)
+    reqs = [b.submit({"data": rs.randn(1, 1, 6).astype(np.float32)},
+                     deadline_ms=10000) for _ in range(12)]
+    for r in reqs:
+        b.wait(r)
+    b.close()
+    evs = obs.events()
+    obs.stop()
+    names = {e["name"] for e in evs}
+    for stage in ("serve_submit", "serve_queue", "serve_coalesce",
+                  "serve_dispatch", "serve_split"):
+        if stage not in names:
+            _fail("serve trace missing stage %r (have %s)"
+                  % (stage, sorted(names)))
+    submitted = {e["args"]["req"] for e in evs
+                 if e["name"] == "serve_submit"}
+    dispatched = set()
+    for e in evs:
+        if e["name"] == "serve_dispatch" and e["ph"] == "X":
+            dispatched.update(e["args"]["reqs"])
+    if not dispatched <= submitted:
+        _fail("dispatched request ids %s never submitted"
+              % sorted(dispatched - submitted))
+    if len(submitted) != 12:
+        _fail("expected 12 submitted request ids, saw %d"
+              % len(submitted))
+    print("obs gate: serve trace ok — %d requests submitted, %d reached "
+          "a dispatch" % (len(submitted), len(dispatched)))
+
+
+def check_registry():
+    from mxnet_tpu import guard, io as mxio, obs, tracecheck
+    from mxnet_tpu.data import stats as dstats
+    from mxnet_tpu.serving import health as shealth
+    snap = obs.REGISTRY.snapshot()
+    legacy = {
+        "data_health": mxio.DATA_HEALTH.report(),
+        "training_health": guard.TRAINING_HEALTH.report(),
+        "serving_health": shealth.SERVING_HEALTH.report(),
+        "pipeline_stats": dstats.PIPELINE_STATS.report(),
+        "retrace_events": {"count": tracecheck.retrace_count()},
+    }
+    missing = ["%s.%s" % (v, k) for v, rep in legacy.items()
+               for k in rep if "%s.%s" % (v, k) not in snap]
+    if missing:
+        _fail("registry snapshot missing legacy keys: %s" % missing)
+    # the Prometheus export must render without blowing up and carry a
+    # representative numeric sample
+    text = obs.REGISTRY.to_prometheus()
+    if "training_health_steps" not in text:
+        _fail("prometheus export lacks training_health_steps")
+    print("obs gate: registry snapshot carries all %d legacy keys"
+          % sum(len(r) for r in legacy.values()))
+
+
+def check_off_cost(tmpdir):
+    from mxnet_tpu import obs
+    from mxnet_tpu.obs import flight
+    # microbenchmark: the off path is one flag check + shared noop
+    obs.stop()
+    was = flight.enabled()
+    flight.set_enabled(False)
+    try:
+        n = 200000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs.span("hot", dispatch=0):
+                pass
+        per_call = (time.perf_counter() - t0) / n
+    finally:
+        flight.set_enabled(was)
+    cap = float(os.environ.get("MXTPU_OBS_OFF_NS_CAP", "5000"))
+    if per_call * 1e9 > cap:
+        _fail("tracing-off span() costs %.0f ns/call (cap %.0f) — the "
+              "off path must stay a flag check" % (per_call * 1e9, cap))
+    # fit A/B: untraced must not be slower than traced beyond noise —
+    # tracing must actually be ON for the t_on side, or the band
+    # compares noise against noise and a costly off-path slips through
+    obs.trace.clear()
+    obs.start()
+    t_on = min(_fit_once(tmpdir, "ab_on_%d" % i) for i in range(2))
+    obs.stop()
+    t_off = min(_fit_once(tmpdir, "ab_off_%d" % i) for i in range(2))
+    tol = float(os.environ.get("MXTPU_OBS_AB_TOL", "1.5"))
+    if t_off > t_on * tol:
+        _fail("tracing-off fit %.3fs vs traced %.3fs exceeds %gx band"
+              % (t_off, t_on, tol))
+    print("obs gate: off-cost ok — span() %.0f ns/call off; fit off "
+          "%.3fs vs traced %.3fs" % (per_call * 1e9, t_off, t_on))
+
+
+def main():
+    logging.basicConfig(level=logging.WARNING)
+    os.environ.setdefault("MXTPU_TRACE", "0")
+    with tempfile.TemporaryDirectory() as tmpdir:
+        os.environ["MXTPU_FLIGHT_RECORDER_PATH"] = os.path.join(
+            tmpdir, "flight.json")
+        from mxnet_tpu import obs  # noqa: F401  (import before arming)
+        check_train_trace(tmpdir)
+        check_serve_trace(tmpdir)
+        check_registry()
+        check_off_cost(tmpdir)
+    print("obs gate PASS")
+
+
+if __name__ == "__main__":
+    main()
