@@ -120,6 +120,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+# every BENCH_* knob is declared ONCE in the BenchConfig table
+# (mxnet_tpu/autotune/benchcfg.py) and read through benv — integers and
+# floats route through base.env_int/env_float, so a junk spelling raises
+# MXNetError naming the variable instead of a raw ValueError / silent
+# truncation. The autotuner's programmatic path reads the same table.
+from mxnet_tpu.autotune.benchcfg import benv, env_set
+# ONE measurement harness shared with the autotuner and the multichip CI
+# gate (docs/perf.md "Autotuning"): bench re-exports it so existing
+# `from bench import measure_scan_ips` callers keep working
+from mxnet_tpu.autotune.harness import (measure_scan_ips,  # noqa: F401
+                                        open_loop_run, serve_model)
+from mxnet_tpu.base import env_float, env_int
+
+
 def _peak_flops(device):
     """Peak dense bf16 FLOP/s by TPU generation — ONE table, owned by
     commscheck (its roofline and this bench's MFU must agree on the same
@@ -171,13 +185,12 @@ def host_overhead_main():
     from mxnet_tpu import models
     from mxnet_tpu.model import CheckpointManager
 
-    batch = int(os.environ.get("BENCH_HO_BATCH", "64"))
-    image = int(os.environ.get("BENCH_HO_IMAGE", "112"))
-    depth = int(os.environ.get("BENCH_DEPTH", "50"))
-    k = int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "4"))
-    nbatches = int(os.environ.get("BENCH_HO_BATCHES", "32"))
-    cadences = [int(c) for c in
-                os.environ.get("BENCH_CKPT_CADENCES", "8,16").split(",")
+    batch = benv("BENCH_HO_BATCH")
+    image = benv("BENCH_HO_IMAGE")
+    depth = benv("BENCH_DEPTH")
+    k = benv("BENCH_STEPS_PER_DISPATCH", 4)
+    nbatches = benv("BENCH_HO_BATCHES")
+    cadences = [int(c) for c in benv("BENCH_CKPT_CADENCES").split(",")
                 if c.strip()]
     from mxnet_tpu import engine
     pl_depth = engine.dispatch_pipeline()
@@ -262,7 +275,7 @@ def _zd_model(name, batch):
     from mxnet_tpu import models
     rng = np.random.default_rng(0)
     if name == "ssd":
-        image = int(os.environ.get("BENCH_ZD_IMAGE", "64"))
+        image = benv("BENCH_ZD_IMAGE")
         sym = models.get_symbol("ssd", num_classes=3, width=16)
         X = rng.normal(size=(batch, 3, image, image)).astype(np.float32)
         lab = rng.random((batch, 4, 5)).astype(np.float32)
@@ -275,7 +288,7 @@ def _zd_model(name, batch):
         return (sym, {"data": X}, {"label": lab}, ("data",), ("label",),
                 mx.metric.MultiBoxMetric())
     if name == "transformer":
-        seq = int(os.environ.get("BENCH_ZD_SEQ", "32"))
+        seq = benv("BENCH_ZD_SEQ")
         sym = models.get_symbol("transformer", vocab_size=64, embed=32,
                                 num_heads=4, num_layers=2, seq_len=seq)
         X = rng.integers(0, 64, (batch, seq)).astype(np.float32)
@@ -301,12 +314,12 @@ def zoo_dispatch_main():
     import mxnet_tpu as mx
     from mxnet_tpu import tracecheck, memcheck
 
-    ndev = int(os.environ.get("BENCH_ZD_DEVICES", "8"))
-    k = int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "4"))
-    batch = int(os.environ.get("BENCH_ZD_BATCH", str(8 * max(1, ndev))))
-    dispatches = int(os.environ.get("BENCH_ZD_DISPATCHES", "6"))
-    model_names = [m for m in os.environ.get(
-        "BENCH_ZD_MODELS", "ssd,transformer").split(",") if m.strip()]
+    ndev = benv("BENCH_ZD_DEVICES")
+    k = benv("BENCH_STEPS_PER_DISPATCH", 4)
+    batch = benv("BENCH_ZD_BATCH") or 8 * max(1, ndev)
+    dispatches = benv("BENCH_ZD_DISPATCHES")
+    model_names = [m for m in benv("BENCH_ZD_MODELS").split(",")
+                   if m.strip()]
     if len(jax.devices()) < ndev:
         raise SystemExit(
             "BENCH_ZD_DEVICES=%d but only %d device(s) visible — on CPU "
@@ -453,23 +466,23 @@ def realdata_main():
     from mxnet_tpu import data as mdata
     from mxnet_tpu.train_step import TrainStep
 
-    batch = int(os.environ.get("BENCH_RD_BATCH", "128"))
-    image = int(os.environ.get("BENCH_RD_IMAGE", "224"))
-    depth = int(os.environ.get("BENCH_DEPTH", "50"))
-    k = max(2, int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "4")))
-    nimg = int(os.environ.get("BENCH_RD_IMAGES", str(batch * k * 8)))
+    batch = benv("BENCH_RD_BATCH")
+    image = benv("BENCH_RD_IMAGE")
+    depth = benv("BENCH_DEPTH")
+    k = max(2, benv("BENCH_STEPS_PER_DISPATCH", 4))
+    nimg = benv("BENCH_RD_IMAGES") or batch * k * 8
     # whole superbatches only: one compiled program, no epoch tail
     nimg = max(batch * k, nimg - nimg % (batch * k))
-    quality = int(os.environ.get("BENCH_RD_QUALITY", "90"))
-    workers = int(os.environ.get("MXTPU_DATA_WORKERS", "0") or 0) \
+    quality = benv("BENCH_RD_QUALITY")
+    workers = env_int("MXTPU_DATA_WORKERS", 0) \
         or min(4, os.cpu_count() or 1)
-    min_ratio = float(os.environ.get("MXTPU_REALDATA_MIN_RATIO", "0.9"))
-    rounds = int(os.environ.get("BENCH_ROUNDS", "2"))
-    cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    min_ratio = env_float("MXTPU_REALDATA_MIN_RATIO", 0.9)
+    rounds = benv("BENCH_ROUNDS", 2)
+    cdtype = benv("BENCH_DTYPE")
     if jax.devices()[0].platform == "cpu":
         cdtype = "float32"  # bf16 matmuls emulate slowly on CPU
 
-    model = os.environ.get("BENCH_RD_MODEL", "resnet")
+    model = benv("BENCH_RD_MODEL")
     if model == "resnet":
         sym = models.resnet(num_classes=8, num_layers=depth,
                             image_shape="3,%d,%d" % (image, image))
@@ -503,7 +516,7 @@ def realdata_main():
     # side (defaults sized for chip hosts; the CI smoke shrinks them — a
     # CPU dispatch takes seconds, so the fixed-latency term the
     # differencing cancels is proportionally tiny there)
-    meas = os.environ.get("BENCH_RD_MEASURE", "12,60").split(",")
+    meas = benv("BENCH_RD_MEASURE").split(",")
     n_short = max(1, (int(meas[0]) + k - 1) // k)
     n_long = max(n_short + 2, (int(meas[1]) + k - 1) // k)
     synth_ips = measure_scan_ips(step, state, sb, batch, k, n_short,
@@ -585,44 +598,28 @@ def realdata_main():
 
 
 def _serve_model(name=None):
-    """Build (engine kwargs) for the serving/fleet benches: symbol +
-    random params at deploy-realistic shapes (weights don't affect
-    latency). ``name`` defaults to the BENCH_SERVE_MODEL env knob."""
-    from mxnet_tpu import models
+    """Build (engine kwargs) for the serving/fleet benches — ONE recipe
+    shared with the autotuner's serving harness
+    (``autotune.harness.serve_model``). ``name`` defaults to the
+    BENCH_SERVE_MODEL env knob."""
+    from mxnet_tpu.base import MXNetError
     if name is None:
-        name = os.environ.get("BENCH_SERVE_MODEL", "mlp")
-    if name == "lenet":
-        sym = models.lenet(num_classes=10)
-        shape = (1, 28, 28)
-    elif name == "mlp":
-        sym = models.mlp(num_classes=10, hidden=(128,))
-        shape = (64,)
-    else:
-        raise SystemExit("bench serve/fleet model must be mlp|lenet, "
-                         "got %r" % name)
-    probe = {"data": (2,) + shape, "softmax_label": (2,)}
-    arg_shapes, _, _ = sym.infer_shape(
-        **{k: v for k, v in probe.items()
-           if k in sym.list_arguments()})
-    rs = np.random.default_rng(0)
-    params = {}
-    for n, s in zip(sym.list_arguments(), arg_shapes):
-        if n in ("data", "softmax_label"):
-            continue
-        params[n] = (rs.normal(size=s) * 0.1).astype(np.float32)
-    return name, sym, params, shape
+        name = benv("BENCH_SERVE_MODEL")
+    try:
+        return serve_model(name)
+    except MXNetError as e:
+        raise SystemExit("bench serve/fleet: %s" % (e,))
 
 
 def serve_main():
     """Serving latency bench: open-loop arrivals at a target QPS through
     the dynamic batcher; one JSON line with p50/p99 latency and achieved
     throughput (docs/serving.md "Latency bench")."""
-    import threading
     from mxnet_tpu import serving, tracecheck
 
-    qps = float(os.environ.get("BENCH_SERVE_QPS", "200"))
-    nreq = int(os.environ.get("BENCH_SERVE_REQS", "400"))
-    nclients = int(os.environ.get("BENCH_SERVE_CLIENTS", "4"))
+    qps = benv("BENCH_SERVE_QPS")
+    nreq = benv("BENCH_SERVE_REQS")
+    nclients = benv("BENCH_SERVE_CLIENTS")
     name, sym, params, shape = _serve_model()
 
     eng = serving.ServingEngine(sym, params, {"data": shape})
@@ -631,39 +628,11 @@ def serve_main():
     x1 = rs.normal(size=(1,) + shape).astype(np.float32)
     batcher.infer({"data": x1})           # warm the smallest bucket path
 
-    latencies = []
-    errors = []
-    lock = threading.Lock()
-    interval = 1.0 / qps
-    t0 = time.perf_counter() + 0.05
-
-    def client(cid):
-        # open-loop: request i is DUE at t0 + i*interval regardless of
-        # how long earlier requests took — queueing delay shows up in the
-        # measured latency instead of silently lowering the offered load
-        for i in range(cid, nreq, nclients):
-            due = t0 + i * interval
-            delay = due - time.perf_counter()
-            if delay > 0:
-                time.sleep(delay)
-            t_start = time.perf_counter()
-            try:
-                batcher.infer({"data": x1})
-                dt = time.perf_counter() - t_start
-                with lock:
-                    latencies.append(dt)
-            except Exception as e:
-                with lock:
-                    errors.append(repr(e))
-
-    threads = [threading.Thread(target=client, args=(c,))
-               for c in range(nclients)]
-    wall0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - wall0
+    # open-loop arrivals through the shared client harness (also drives
+    # the autotuner's serving trials): request i is DUE at t0+i/qps, so
+    # queueing delay lands in the measured latency, never in offered load
+    latencies, errors, wall = open_loop_run(
+        batcher.infer, {"data": x1}, qps, nreq, nclients=nclients)
     batcher.close()
     if not latencies:
         raise RuntimeError("serving bench completed no requests: %s"
@@ -801,21 +770,19 @@ def fleet_main():
     import threading
     from mxnet_tpu import serving, tracecheck
 
-    nrep = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
-    qps = float(os.environ.get("BENCH_FLEET_QPS", "500"))
-    nreq = int(os.environ.get("BENCH_FLEET_REQS", "600"))
-    nreq_single = int(os.environ.get("BENCH_FLEET_SINGLE_REQS", "200"))
-    batch_frac = float(os.environ.get("BENCH_FLEET_BATCH_FRAC", "0.25"))
-    device_ms = float(os.environ.get("BENCH_FLEET_DEVICE_MS", "40"))
-    deadline_ms = float(os.environ.get("BENCH_FLEET_DEADLINE_MS", "20000"))
+    nrep = benv("BENCH_FLEET_REPLICAS")
+    qps = benv("BENCH_FLEET_QPS")
+    nreq = benv("BENCH_FLEET_REQS")
+    nreq_single = benv("BENCH_FLEET_SINGLE_REQS")
+    batch_frac = benv("BENCH_FLEET_BATCH_FRAC")
+    device_ms = benv("BENCH_FLEET_DEVICE_MS")
+    deadline_ms = benv("BENCH_FLEET_DEADLINE_MS")
     # one dispatch serves at most this many co-riders: with the emulated
     # device time this pins a replica's capacity (max_batch/cycle) well
     # below the offered QPS, so BOTH phases measure capacity, not load
-    max_batch = int(os.environ.get("BENCH_FLEET_MAX_BATCH", "8"))
-    do_drain = os.environ.get("BENCH_FLEET_DRAIN", "1").strip() \
-        not in ("", "0")
-    name, sym, params, shape = _serve_model(
-        os.environ.get("BENCH_FLEET_MODEL", "mlp"))
+    max_batch = benv("BENCH_FLEET_MAX_BATCH")
+    do_drain = benv("BENCH_FLEET_DRAIN")
+    name, sym, params, shape = _serve_model(benv("BENCH_FLEET_MODEL"))
     rs = np.random.default_rng(1)
     x1 = rs.normal(size=(1,) + shape).astype(np.float32)
 
@@ -925,41 +892,6 @@ def fleet_main():
     print(json.dumps(out))
 
 
-def measure_scan_ips(step, state, sb, batch, k, n_short, n_long, rounds=2,
-                     warmup=2):
-    """Steady-state img/s of the fused K-step scan: short/long differencing
-    (fixed per-readback latency cancels — same methodology as the headline
-    bench), best of ``rounds`` so one scheduler hiccup costs a retry, not
-    the measurement (a round whose timing inverts contributes nothing).
-    Shared by BENCH_DP_DEVICES and the multichip CI gate — ONE harness, so
-    the efficiency ratio always compares like with like."""
-    st = [state]
-
-    def run(dispatches):
-        t0 = time.perf_counter()
-        for _ in range(dispatches):
-            st[0], _m = step.run_steps(st[0], sb)
-        np.asarray(st[0]["step"])  # forced readback (tunnel-honored sync)
-        return time.perf_counter() - t0
-
-    run(warmup)  # warmup / compile
-    best = 0.0
-    for _ in range(rounds):
-        t_short = run(n_short)
-        t_long = run(n_long)
-        if t_long > t_short:
-            best = max(best, batch * k * (n_long - n_short)
-                       / (t_long - t_short))
-    if best == 0.0:
-        # every round's timing inverted: the 0.0 a caller is about to
-        # publish (or gate on) is a measurement failure, not a throughput
-        print("WARNING: measure_scan_ips produced no valid sample — "
-              "t_long <= t_short in all %d round(s); the host is too "
-              "loaded for n_short=%d/n_long=%d dispatches"
-              % (rounds, n_short, n_long), file=sys.stderr)
-    return best
-
-
 def _dp_scaling_row(sym, dshape, batch, sdtype, cdtype, remat, spd, rounds):
     """BENCH_DP_DEVICES=N: measure the fused K-step scan single-device and
     sharded over an N-way 'data' mesh at the SAME global batch (docs/perf.md
@@ -971,7 +903,7 @@ def _dp_scaling_row(sym, dshape, batch, sdtype, cdtype, remat, spd, rounds):
     from mxnet_tpu.train_step import TrainStep
     from mxnet_tpu.parallel.mesh import data_parallel_mesh
 
-    n = int(os.environ.get("BENCH_DP_DEVICES"))
+    n = benv("BENCH_DP_DEVICES")
     k = max(1, spd)
     sharded = {}  # the n-device side's program + struct args for commscheck
 
@@ -1037,12 +969,12 @@ def main():
     from mxnet_tpu import models
     from mxnet_tpu.train_step import TrainStep
 
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
-    rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
-    depth = int(os.environ.get("BENCH_DEPTH", "50"))
-    image = int(os.environ.get("BENCH_IMAGE", "224"))
-    cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    dp_n = int(os.environ.get("BENCH_DP_DEVICES", "0") or 0)
+    batch = benv("BENCH_BATCH")
+    rounds = benv("BENCH_ROUNDS")
+    depth = benv("BENCH_DEPTH")
+    image = benv("BENCH_IMAGE")
+    cdtype = benv("BENCH_DTYPE")
+    dp_n = benv("BENCH_DP_DEVICES")
     if dp_n > 1:
         # validate BEFORE the headline measurement: a misconfigured env
         # must not discard minutes of already-measured throughput
@@ -1061,15 +993,15 @@ def main():
 
     # measured r4: remat=conv loses ~17% on v5e (recompute re-reads conv
     # outputs; chip is HBM-bound) — remat stays a memory knob, not a default
-    remat = os.environ.get("BENCH_REMAT", "off")  # conv|full|off
+    remat = benv("BENCH_REMAT")  # conv|full|off
     # measured r4: NHWC+Pallas conv+BN-stats fusion is 2x SLOWER than
     # letting XLA fuse (docs/perf.md r4 section) — NCHW/XLA stays default
-    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    layout = benv("BENCH_LAYOUT")
     dshape = ((batch, image, image, 3) if layout == "NHWC"
               else (batch, 3, image, image))
     # BENCH_STORAGE_DTYPE=bfloat16 stores params+optimizer state in bf16
     # (no f32 masters) — measured r5, see docs/perf.md
-    sdtype = os.environ.get("BENCH_STORAGE_DTYPE", "float32")
+    sdtype = benv("BENCH_STORAGE_DTYPE")
     sym = models.resnet(num_classes=1000, num_layers=depth,
                         image_shape="3,%d,%d" % (image, image),
                         layout=layout)
@@ -1092,7 +1024,25 @@ def main():
     # (lax.scan). The superbatch is built ON DEVICE once — input cost is out
     # of the loop, so this measures the pure dispatch-amortization win the
     # per-step mode leaves on the table.
-    spd = int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "1"))
+    # BENCH_STEPS_PER_DISPATCH resolution (docs/perf.md "Autotuning"):
+    # env > tuning DB > default — and the JSON line SAYS which source won,
+    # so a bench number is always attributable to its configuration
+    from mxnet_tpu import autotune as _autotune
+    spd = benv("BENCH_STEPS_PER_DISPATCH")
+    at_block = {"steps_per_dispatch": {
+        "value": spd,
+        "source": "env" if env_set("BENCH_STEPS_PER_DISPATCH")
+        else "default"}}
+    if at_block["steps_per_dispatch"]["source"] == "default":
+        db_key, db_knobs = _autotune.resolve_train_knobs(sym, batch)
+        if db_knobs and "steps_per_dispatch" in db_knobs:
+            spd = max(1, int(db_knobs["steps_per_dispatch"]))
+            at_block = {"steps_per_dispatch": {"value": spd,
+                                               "source": "db"},
+                        "db_entry": db_key,
+                        "db": _autotune.default_db_path()}
+            _autotune.note_db_resolution(None, "bench.py", db_key,
+                                         {"steps_per_dispatch": spd})
     if spd > 1:
         sbatch = {n: jnp.stack([v] * spd) for n, v in data.items()}
 
@@ -1259,20 +1209,21 @@ def main():
     if dp_n > 1:
         out["dp"] = _dp_scaling_row(sym, dshape, batch, sdtype, cdtype,
                                     remat, spd, rounds)
+    out["autotune"] = at_block
     out["obs"] = _obs_block()
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_ZOO_DISPATCH", "").strip() not in ("", "0"):
+    if benv("BENCH_ZOO_DISPATCH"):
         zoo_dispatch_main()
-    elif os.environ.get("BENCH_REAL_DATA", "").strip() not in ("", "0"):
+    elif benv("BENCH_REAL_DATA"):
         realdata_main()
-    elif os.environ.get("BENCH_FLEET", "").strip() not in ("", "0"):
+    elif benv("BENCH_FLEET"):
         fleet_main()
-    elif os.environ.get("BENCH_SERVE", "").strip() not in ("", "0"):
+    elif benv("BENCH_SERVE"):
         serve_main()
-    elif os.environ.get("BENCH_HOST_OVERHEAD", "").strip() not in ("", "0"):
+    elif benv("BENCH_HOST_OVERHEAD"):
         host_overhead_main()
     else:
         main()

@@ -1,0 +1,22 @@
+#!/bin/sh
+# CI gate: autotuner smoke (docs/perf.md "Autotuning"). Tiny exhaustive
+# grid over the zoo mlp on CPU through the in-process bench harness:
+# asserts (a) the memcheck pruner statically rejects the over-budget K=16
+# candidate without ever executing it, (b) a winner whose measured img/s
+# >= the built-in default's is persisted to the tuning DB, and (c) a
+# FRESH Module.fit with no knob arguments resolves the winner's knobs
+# from the DB (obs-logged) with zero extra retraces (assert_no_retrace).
+#
+# The gate writes a SCRATCH DB — refreshing the committed AUTOTUNE_db.json
+# is the operator workflow:
+#   python -m mxnet_tpu.autotune --model mlp --objective img_per_sec \
+#       --batch 48 --write-db   # then commit AUTOTUNE_db.json
+set -e
+cd "$(dirname "$0")/.."
+DB="$(mktemp -t autotune_ci_XXXXXX.json)"
+rm -f "$DB"
+trap 'rm -f "$DB"' EXIT
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    MXTPU_AUTOTUNE_DB="$DB" \
+    python tools/autotune_gate.py
+echo "autotune PASS"

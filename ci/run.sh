@@ -33,6 +33,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # documented reason) — precheck sweep over the whole zoo + real
 # steps_per_dispatch fits on the cheap models, tracecheck-clean
 ./ci/zoo_dispatch.sh
+# autotuner smoke (docs/perf.md "Autotuning"): tiny grid over mlp —
+# memcheck pruner rejects the over-budget candidate without executing
+# it, a measured winner >= the default persists to the tuning DB, and a
+# fresh Module.fit resolves it (obs-logged) with zero extra retraces
+./ci/autotune.sh
 # serving-tier smoke: AOT buckets + dynamic batcher at low QPS, zero
 # tracecheck findings on the serving program set (docs/serving.md)
 ./ci/serve.sh

@@ -6,6 +6,7 @@ See SURVEY.md at the repo root for the structural map of the reference
 """
 from .base import MXNetError, __version__
 from . import obs
+from . import autotune
 from . import faults
 from . import guard
 from .guard import TrainingGuard, TrainingHealth, TrainingDivergedError

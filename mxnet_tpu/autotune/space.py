@@ -1,0 +1,35 @@
+"""Default knob spaces per objective (docs/perf.md "Autotuning").
+
+The FIRST value of every knob is its built-in default — the search driver
+relies on that to make trial #0 the default config, so every sweep's
+winner is comparable against what an untuned run would have done.
+"""
+from __future__ import annotations
+
+from .search import Knob
+
+
+def train_space(spd_values=None, pipeline_values=None):
+    """Training objectives: the fused-dispatch K and the deferred-readback
+    pipeline depth (docs/perf.md "Dispatch bulking" / "Host off the
+    critical path")."""
+    return [
+        Knob("steps_per_dispatch", tuple(spd_values or (1, 2, 4, 8))),
+        Knob("dispatch_pipeline", tuple(pipeline_values or (1, 0, 2))),
+    ]
+
+
+def serve_space(bucket_values=None, latency_values=None):
+    """Serving objectives: the AOT bucket set and the batcher's coalescing
+    window (docs/serving.md)."""
+    return [
+        Knob("buckets", tuple(bucket_values
+                              or ("1,8,32", "1,8", "1,16,64"))),
+        Knob("max_latency_ms", tuple(latency_values or (5.0, 2.0, 10.0))),
+    ]
+
+
+def space_for(objective, **overrides):
+    if objective in ("img_per_sec", "tokens_per_sec"):
+        return train_space(**overrides)
+    return serve_space(**overrides)

@@ -26,6 +26,9 @@ import jax
 
 _naive = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
 _bulk_steps = int(os.environ.get("MXTPU_BULK_STEPS", "1") or 1)
+# whether set_bulk_size was ever called: an EXPLICIT bulk(1) must read as
+# "the operator asked for 1", not "unset — consult the tuning DB"
+_bulk_explicit = False
 
 
 def set_engine_type(name):
@@ -42,9 +45,10 @@ def is_naive():
 def set_bulk_size(size):
     """Set the default steps-per-dispatch for training loops; returns the
     previous value (ref: Engine::set_bulk_size)."""
-    global _bulk_steps
+    global _bulk_steps, _bulk_explicit
     prev = _bulk_steps
     _bulk_steps = max(1, int(size))
+    _bulk_explicit = True
     return prev
 
 
@@ -53,16 +57,33 @@ def bulk_size():
     return _bulk_steps
 
 
+def bulk_configured():
+    """Whether the bulk size was explicitly configured (``MXTPU_BULK_STEPS``
+    env or a ``bulk()``/``set_bulk_size`` call — INCLUDING an explicit
+    ``bulk(1)``, which means "the operator asked for 1", not "unset") —
+    the precedence probe that lets ``fit``'s knob resolution distinguish
+    an operator choice from "nobody said anything, consult the tuning DB"
+    (docs/perf.md "Autotuning")."""
+    if _bulk_explicit or _bulk_steps != 1:
+        return True
+    return bool(os.environ.get("MXTPU_BULK_STEPS", "").strip())
+
+
 @contextlib.contextmanager
 def bulk(size):
     """Scoped dispatch bulking: ``with mx.engine.bulk(8): mod.fit(...)``
     trains 8 steps per compiled dispatch (the reference's engine bulk
-    scope, applied at train-loop granularity)."""
-    prev = set_bulk_size(size)
+    scope, applied at train-loop granularity). Exit restores BOTH the
+    previous size and the was-explicitly-set flag, so a transient scope
+    never leaves the process looking operator-configured (which would
+    disarm tuning-DB resolution for every later fit)."""
+    global _bulk_steps, _bulk_explicit
+    prev, prev_flag = _bulk_steps, _bulk_explicit
+    set_bulk_size(size)
     try:
         yield
     finally:
-        set_bulk_size(prev)
+        _bulk_steps, _bulk_explicit = prev, prev_flag
 
 
 _pipeline_override = None
@@ -86,6 +107,16 @@ def dispatch_pipeline():
         from .base import MXNetError
         raise MXNetError(
             "MXTPU_DISPATCH_PIPELINE must be an integer, got %r" % v)
+
+
+def dispatch_pipeline_configured():
+    """Whether the pipeline depth was explicitly configured (env or
+    ``set_dispatch_pipeline``) rather than defaulted — see
+    :func:`bulk_configured` for why resolution needs to know
+    (docs/perf.md "Autotuning")."""
+    if _pipeline_override is not None:
+        return True
+    return bool(os.environ.get("MXTPU_DISPATCH_PIPELINE", "").strip())
 
 
 def set_dispatch_pipeline(depth):

@@ -271,7 +271,6 @@ class BaseModule(object):
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
-        from .. import engine as _engine
         ckpt_mgr = None
         resume_state = None
         if checkpoint_prefix is not None:
@@ -365,9 +364,14 @@ class BaseModule(object):
 
         fused_step = getattr(self, "_try_fused_fit_step", None)
         fused_dispatch = getattr(self, "_dispatch_fused_steps", None)
-        k = (steps_per_dispatch if steps_per_dispatch is not None
-             else _engine.bulk_size())
-        k = max(1, int(k))
+        # knob resolution (docs/perf.md "Autotuning"): explicit arg > env
+        # > tuning DB > built-in default, per knob — a DB hit is logged
+        # once per run via the obs registry, so the training log always
+        # says where the configuration came from
+        from .. import autotune as _autotune
+        k, pl_depth, _knob_src = _autotune.resolve_fit_knobs(
+            self, train_data, steps_per_dispatch, dispatch_pipeline,
+            logger=self.logger)
         if k > 1:
             reason = None
             if monitor is not None:
@@ -399,8 +403,6 @@ class BaseModule(object):
         # eager mode is auto-selected for per-step configurations — k=1
         # trains through per-step host metrics, whose output readback is
         # the sync point the pipeline would otherwise defer
-        pl_depth = (dispatch_pipeline if dispatch_pipeline is not None
-                    else _engine.dispatch_pipeline())
         pl_depth = max(0, int(pl_depth))
         if k <= 1 or fused_dispatch is None:
             pl_depth = 0
@@ -710,6 +712,55 @@ class BaseModule(object):
                 # exception paths included: never leave a producer thread
                 # consuming the user's iterator (close() is idempotent)
                 train_iter.close()
+
+    # -- fused-dispatch hooks shared by Module and BucketingModule ------
+    def _note_dispatch_retired(self, sums, nsteps):
+        """Retirement hook for the dispatch pipeline: advance the
+        host-side step-clock mirror for a GUARDED dispatch once its
+        sentinels (the device-side skip count) have been fetched —
+        skipped steps are full no-ops, the clock must not count them.
+        Unguarded dispatches advanced at dispatch time."""
+        if getattr(sums, "guarded", False):
+            self._fused_host_step += int(nsteps) - sums.skipped
+
+    def _feed_guard_sentinels(self, guard, sent):
+        """Host side of one GUARDED single-step dispatch: advance the
+        step-clock mirror skip-aware and feed the packed ``[loss,
+        correct, nsamp, skipped, grad_norm]`` sentinel array to the
+        guard (``last_step_skipped`` tells fit to keep the skipped batch
+        out of host-side metrics). ONE definition — the sentinel packet
+        layout must never drift between the Module and BucketingModule
+        paths."""
+        self._fused_host_step += 1 - int(sent[3] > 0)
+        guard.on_dispatch(loss_sum=float(sent[0]), nsamp=float(sent[2]),
+                          skipped=float(sent[3]),
+                          grad_norm=float(sent[4]), nsteps=1)
+        guard.last_step_skipped = bool(sent[3] > 0)
+
+    def _adopt_retrace_result(self, e, nsteps, guard):
+        """``MXTPU_TRACECHECK=error`` raised mid-dispatch
+        (tracecheck.RetraceError): the dispatch already ran and DONATED
+        the previous fused state, and the new state rides in
+        ``e.result`` — adopt it so ``_fused_state`` never dangles on
+        deleted buffers (``get_params`` / emergency checkpoints after
+        catching the error keep working). The step-clock mirror advances
+        as on the success path; the run is aborting, so the guarded
+        paths' sentinel readback costs nothing that matters."""
+        if e.result is None:
+            return
+        self._fused_state = e.result[0]
+        self._fused_outputs = None
+        self._fused_dirty = True
+        self._params_dirty = True
+        if guard is None:
+            self._fused_host_step += nsteps
+            return
+        tail = e.result[-1]
+        if hasattr(tail, "skipped"):   # StepMetrics (run_steps path)
+            skipped = int(tail.skipped)
+        else:                          # packed sentinel array (step path)
+            skipped = int(np.asarray(tail)[3] > 0)
+        self._fused_host_step += nsteps - skipped
 
     # -- fault tolerance hooks (docs/robustness.md) ---------------------
     def _guard_rollback(self, guard, ckpt_mgr):

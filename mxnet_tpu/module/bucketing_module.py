@@ -241,8 +241,14 @@ class BucketingModule(BaseModule):
         return True, None
 
     def _can_guard(self):
-        return (False, "bucketed dispatch trains unguarded (per-bucket "
-                "fused programs carry no guard sentinels yet)")
+        """Guard eligibility (docs/robustness.md "Numerical guardrails"):
+        the per-bucket fused scans carry the same device sentinels as the
+        single-symbol path — grad-norm + all-finite computed inside each
+        bucket's compiled body, skipped steps excluded from every
+        accumulator slot — so a bucketed model no longer trains UNGUARDED
+        under ``MXTPU_GUARD=1``. Same eligibility set as dispatch
+        bulking (the sentinels ride the fused programs)."""
+        return self._can_bulk_dispatch()
 
     def _get_bucket_step(self, bucket_key):
         """The bucket's compiled TrainStep, built lazily from its symbol —
@@ -365,9 +371,15 @@ class BucketingModule(BaseModule):
         through THIS bucket's compiled program over the shared state tree
         (the jit cache plays the reference's shared-storage re-bind role
         one level up — per bucket SHAPE, not per bucket executor).
-        Returns None when this superbatch must train per-step."""
-        if guard is not None:
-            return None
+        Returns None when this superbatch must train per-step.
+
+        With a :class:`~mxnet_tpu.guard.TrainingGuard` the bucket's
+        GUARDED scan runs instead (separate jit cache per bucket, same
+        shared state): grad-norm/all-finite sentinels inside the compiled
+        body, non-finite steps are device-side no-ops excluded from every
+        accumulator slot, and the sentinels ride back with the metric
+        sums in the one per-K readback (docs/robustness.md "Numerical
+        guardrails")."""
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return None
@@ -396,12 +408,28 @@ class BucketingModule(BaseModule):
         for name, v in zip(ts.label_names, super_batch.label or []):
             feed[name] = v
         feed = ts.shard_superbatch(feed)
-        self._fused_state, sums = ts.run_steps(self._fused_state, feed,
-                                               metric_spec=spec)
+        # retrace events attribute to THIS run's health when guarded
+        ts.health = guard.health if guard is not None else None
+        from ..tracecheck import RetraceError
+        try:
+            self._fused_state, sums = ts.run_steps(
+                self._fused_state, feed, guard=guard is not None,
+                metric_spec=spec)
+        except RetraceError as e:
+            # the dispatch already ran and donated the shared state:
+            # adopt the new tree (BaseModule hook) before re-raising so
+            # get_params/emergency checkpoints never dangle
+            self._adopt_retrace_result(e, super_batch.num_steps, guard)
+            raise
         self._fused_outputs = None
         self._fused_dirty = True
         self._params_dirty = True
-        self._fused_host_step += super_batch.num_steps
+        if guard is None:
+            # unguarded: every step lands, the mirror advances at
+            # dispatch; guarded dispatches advance at retirement (the
+            # skip count rides the sentinel readback —
+            # ``BaseModule._note_dispatch_retired``)
+            self._fused_host_step += super_batch.num_steps
         return sums
 
     def _try_fused_fit_step(self, data_batch, guard=None):
@@ -409,9 +437,9 @@ class BucketingModule(BaseModule):
         single-step program over the SAME shared state — so a superbatch
         cut short by a bucket switch never detours through the executor
         (whose optimizer state would then diverge from the donated
-        tree)."""
-        if guard is not None:
-            return False
+        tree). With a guard, the bucket's GUARDED single step runs (same
+        sentinel packet as the single-symbol path) and a skipped step is
+        kept out of the host-side metric via ``guard.last_step_skipped``."""
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return False
@@ -425,6 +453,7 @@ class BucketingModule(BaseModule):
         if not self._ensure_fused_state(ts):
             return False
         import jax.numpy as jnp
+        import numpy as _np
         from ..ndarray import NDArray
 
         def to_jnp(v):
@@ -435,12 +464,77 @@ class BucketingModule(BaseModule):
             feed[name] = to_jnp(v)
         for name, v in zip(ts.label_names, data_batch.label or []):
             feed[name] = to_jnp(v)
-        self._fused_state, outs = ts.step(self._fused_state, feed)
+        ts.health = guard.health if guard is not None else None
+        from ..tracecheck import RetraceError
+        if guard is not None:
+            guard.last_step_skipped = False
+            try:
+                self._fused_state, outs, packed = ts.step(
+                    self._fused_state, feed, guard=True)
+            except RetraceError as e:
+                self._adopt_retrace_result(e, 1, guard)
+                raise
+            self._fused_outputs = [NDArray(o) for o in outs]
+            self._fused_dirty = True
+            self._params_dirty = True
+            self._feed_guard_sentinels(guard, _np.asarray(packed))
+            return True
+        try:
+            self._fused_state, outs = ts.step(self._fused_state, feed)
+        except RetraceError as e:
+            self._adopt_retrace_result(e, 1, None)
+            raise
         self._fused_outputs = [NDArray(o) for o in outs]
         self._fused_dirty = True
         self._params_dirty = True
         self._fused_host_step += 1
         return True
+
+    # -- divergence rollback / resume hooks (docs/robustness.md) ---------
+    def _drop_fused_state(self):
+        """Divergence-rollback hook: discard the shared state tree WITHOUT
+        flushing it (it holds the diverged params/moments). The next
+        dispatch reseeds from the default bucket's executor arrays +
+        updater states the rollback just restored; the per-bucket
+        TrainSteps and their jit caches survive — a rollback never
+        recompiles."""
+        self._fused_state = None
+        self._fused_outputs = None
+        self._fused_dirty = False
+        self._fused_params_stale = False
+
+    def _scale_lr(self, factor):
+        """Divergence-rollback hook: one optimizer instance is shared by
+        every bucket TrainStep, so the base module's reduction covers the
+        whole module."""
+        self._base_module._scale_lr(factor)
+
+    def _restore_trainer_clock(self, num_update, fused_step=None):
+        """Resume/rollback hook: wind the (shared) optimizer clocks
+        through the base module, then pin the bucketed host-side step
+        mirror — and the live shared state's device counter, if any — to
+        the checkpointed fused step (trails ``num_update`` by the guard's
+        skip count)."""
+        base = self._base_module
+        base._restore_trainer_clock(num_update, fused_step)
+        self._fused_host_step = base._resume_step
+        if self._fused_state is not None:
+            import jax.numpy as jnp
+            self._fused_state["step"] = jnp.full(
+                (), self._fused_host_step, jnp.int32)
+
+    def load_optimizer_states(self, fname):
+        """Restore updater states through the current module; the shared
+        fused tree reseeds from them at the next dispatch."""
+        self._curr_module.load_optimizer_states(fname)
+        self._fused_params_stale = True
+
+    def _fused_step_count(self):
+        """Checkpoint-manifest hook: the bucketed host-side mirror of the
+        shared device step counter (never a device sync)."""
+        if self._fused_state is None:
+            return None
+        return int(self._fused_host_step)
 
     def _sync_fused_to_executor(self):
         """Write the shared fused params/aux back into the default

@@ -784,14 +784,7 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
             # the per-step path reads outputs for the metric anyway, so the
             # sentinel readback costs no extra sync point
             import numpy as _np
-            sent = _np.asarray(packed)
-            # a skipped step is a device-side no-op: the step clock mirror
-            # must not advance for it either
-            self._fused_host_step += 1 - int(sent[3] > 0)
-            guard.on_dispatch(loss_sum=float(sent[0]), nsamp=float(sent[2]),
-                              skipped=float(sent[3]),
-                              grad_norm=float(sent[4]), nsteps=1)
-            guard.last_step_skipped = bool(sent[3] > 0)
+            self._feed_guard_sentinels(guard, _np.asarray(packed))
             return True
         try:
             self._fused_state, outs = self._fused.step(
@@ -873,39 +866,9 @@ StepMetrics` WITHOUT reading it back — the packed metric/sentinel array is
         self._params_dirty = True
         return sums
 
-    def _adopt_retrace_result(self, e, nsteps, guard):
-        """``MXTPU_TRACECHECK=error`` raised mid-dispatch
-        (tracecheck.RetraceError): the dispatch already ran and DONATED the
-        previous fused state, and the new state rides in ``e.result`` —
-        adopt it so ``_fused_state`` never dangles on deleted buffers
-        (``get_params`` / emergency checkpoints after catching the error
-        keep working). The step-clock mirror advances as on the success
-        path; the run is aborting, so the guarded paths' sentinel readback
-        costs nothing that matters."""
-        if e.result is None:
-            return
-        self._fused_state = e.result[0]
-        self._fused_outputs = None
-        self._fused_dirty = True
-        self._params_dirty = True
-        if guard is None:
-            self._fused_host_step += nsteps
-            return
-        import numpy as _np
-        tail = e.result[-1]
-        if hasattr(tail, "skipped"):   # StepMetrics (run_steps path)
-            skipped = int(tail.skipped)
-        else:                          # packed sentinel array (step path)
-            skipped = int(_np.asarray(tail)[3] > 0)
-        self._fused_host_step += nsteps - skipped
-
-    def _note_dispatch_retired(self, sums, nsteps):
-        """Retirement hook for the dispatch pipeline: advance the host-side
-        step-clock mirror for a GUARDED dispatch once its sentinels (the
-        device-side skip count) have been fetched. Unguarded dispatches
-        advanced at dispatch time."""
-        if getattr(sums, "guarded", False):
-            self._fused_host_step += int(nsteps) - sums.skipped
+    # _adopt_retrace_result / _note_dispatch_retired live on BaseModule —
+    # shared verbatim with BucketingModule so the sentinel/step-clock
+    # protocol can never drift between the two fused paths
 
     def _sync_fused_to_executor(self):
         """Write fused params/aux back into the executor arrays (copies —

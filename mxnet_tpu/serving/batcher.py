@@ -40,7 +40,7 @@ import time
 
 import numpy as np
 
-from ..base import MXNetError, env_float, env_int
+from ..base import MXNetError, env_float, env_int, env_str
 from ..obs import trace as _obs
 from .health import ServingHealth, SERVING_HEALTH
 
@@ -154,6 +154,22 @@ class Batcher(object):
                  queue_size=None, deadline_ms=None, health=None, start=True,
                  fault_site=None):
         self.engine = engine
+        # knob precedence (docs/perf.md "Autotuning"): ctor arg > env >
+        # the engine's tuning-DB entry (stashed at load as ``_autotuned``)
+        # > built-in default. Only knobs the tuner actually searches are
+        # resolved, and an unusable DB value falls back instead of
+        # raising into the deploy it configures.
+        _tuned = getattr(engine, "_autotuned", None) or {}
+        if max_latency_ms is None \
+                and not env_str("MXTPU_SERVE_MAX_LATENCY_MS") \
+                and "max_latency_ms" in _tuned:
+            try:
+                max_latency_ms = float(_tuned["max_latency_ms"])
+            except (TypeError, ValueError):
+                logging.warning(
+                    "autotune: tuning-DB max_latency_ms %r is unusable — "
+                    "built-in default applies",
+                    _tuned["max_latency_ms"])
         self.max_batch = int(max_batch if max_batch is not None
                              else env_float("MXTPU_SERVE_MAX_BATCH",
                                             engine.max_batch))

@@ -198,6 +198,31 @@ class ServingEngine(object):
         self._input_dtypes = {
             n: np.dtype((input_dtypes or {}).get(n, np.float32))
             for n in self._input_names}
+        # bucket-set resolution (docs/perf.md "Autotuning"): explicit
+        # ``buckets=`` > MXTPU_SERVE_BUCKETS env > tuning DB > built-in
+        # default — a DB hit also stashes the entry's other serving knobs
+        # (``_autotuned``) for the Batcher to resolve against, and is
+        # logged once via the obs registry
+        self._autotuned = None
+        if buckets is None and not env_str("MXTPU_SERVE_BUCKETS"):
+            from .. import autotune as _autotune
+            entry_key, knobs = _autotune.resolve_serve_knobs(self._symbol)
+            if knobs and knobs.get("buckets"):
+                try:
+                    # the DB must never be able to break the deploy it
+                    # configures: a hand-edited/corrupt bucket spec falls
+                    # back to defaults with a warning, like a stale schema
+                    buckets = _autotune.parse_buckets(knobs["buckets"])
+                    self._autotuned = knobs
+                    _autotune.note_db_resolution(
+                        logging, "ServingEngine", entry_key,
+                        {"buckets": knobs["buckets"]})
+                except MXNetError as e:
+                    logging.warning(
+                        "autotune: tuning-DB entry %s carries an unusable "
+                        "bucket spec (%s) — built-in defaults apply",
+                        entry_key, e)
+                    buckets = None
         self.buckets = tuple(sorted(set(
             int(b) for b in (buckets or default_buckets()))))
         if not self.buckets or self.buckets[0] < 1:

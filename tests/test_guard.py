@@ -746,3 +746,135 @@ def test_spiked_observation_never_updates_ema():
     assert g._ema == ema and g._spike_run == 1 and not g.diverged
     g.on_dispatch(loss_sum=1.0, nsamp=1, skipped=0, grad_norm=0.1)
     assert g._spike_run == 0
+
+
+# -- bucketed guard: per-bucket scans carry the sentinels --------------------
+# (ROADMAP item 3 first gap: BucketingModule used to train UNGUARDED under
+# MXTPU_GUARD=1 because the per-bucket fused programs had no sentinels)
+
+def _bucket_sym_gen(key):
+    data = sym.Variable("data")
+    emb = sym.Embedding(data=data, input_dim=16, output_dim=8,
+                        name="shared_embed")
+    feat = sym.sum(emb, axis=1)
+    pred = sym.FullyConnected(data=feat, num_hidden=8, name="shared_fc")
+    return (sym.SoftmaxOutput(data=pred, name="softmax"),
+            ("data",), ("softmax_label",))
+
+
+class _BucketIter(mx.io.DataIter):
+    """Deterministic bucketed stream: run-length-grouped bucket keys."""
+
+    def __init__(self, keys, batch=4, seed=0):
+        super().__init__(batch)
+        rng = np.random.default_rng(seed)
+        self.batches = []
+        for key in keys:
+            self.batches.append(mx.io.DataBatch(
+                data=[mx.nd.array(rng.integers(0, 16, (batch, key))
+                                  .astype(np.float32))],
+                label=[mx.nd.array(rng.integers(0, 8, batch)
+                                   .astype(np.float32))],
+                pad=0, bucket_key=key,
+                provide_data=[mx.io.DataDesc("data", (batch, key))],
+                provide_label=[mx.io.DataDesc("softmax_label", (batch,))]))
+        self.i = 0
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", (4, 10))]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax_label", (4,))]
+
+    def reset(self):
+        self.i = 0
+
+    def next(self):
+        if self.i >= len(self.batches):
+            raise StopIteration
+        b = self.batches[self.i]
+        self.i += 1
+        return b
+
+
+def _bucketed_guarded_fit(keys, k, guard, num_epoch=1, prefix=None,
+                          every=None, seed=21):
+    from mxnet_tpu.module import BucketingModule
+    it = _BucketIter(keys)
+    mod = BucketingModule(_bucket_sym_gen, default_bucket_key=10,
+                          context=mx.cpu())
+    mx.random.seed(seed)
+    metric = mx.metric.create(["acc", "ce"])
+    mod.fit(it, num_epoch=num_epoch, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1}, eval_metric=metric,
+            steps_per_dispatch=k, guard=guard, checkpoint_prefix=prefix,
+            checkpoint_every_n_batches=every, checkpoint_keep=10)
+    return mod, metric
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_bucketed_fit_guard_skips_nan_batch(k):
+    """guard.grad_nan under bucketed dispatch: the poisoned step is a
+    device-side no-op inside the BUCKET's guarded program — counted,
+    excluded from the metric denominators, host step-clock mirror not
+    advanced, params stay finite. k=1 exercises the guarded bucket-tail
+    single step, k=4 the guarded per-bucket scan."""
+    keys = [10] * 4 + [6] * 4
+    faults.inject("guard.grad_nan", nth=3)
+    g = TrainingGuard(max_skips_per_window=100)
+    mod, metric = _bucketed_guarded_fit(keys, k, g)
+    assert g.health.skipped == 1
+    assert g.health.steps == 8
+    assert mod._fused_host_step == 7  # the skipped step did not advance
+    for m in metric.metrics:
+        assert m.num_inst == (8 - 1) * 4  # skipped batch excluded
+    arg, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())
+    assert guard_mod.TRAINING_HEALTH.report()["skipped"] == 1
+
+
+def test_bucketed_guarded_matches_unguarded_when_clean():
+    """A clean guarded bucketed run trains the SAME numbers as the
+    unguarded one (the sentinel where-selects are no-ops on finite
+    steps) — params bitwise across both bucket shapes."""
+    keys = [10] * 4 + [6] * 4
+    g = TrainingGuard(max_skips_per_window=100)
+    mod_g, _ = _bucketed_guarded_fit(keys, 4, g)
+    assert g.health.skipped == 0
+    mod_u, _ = _bucketed_guarded_fit(keys, 4, None)
+    arg_g, _ = mod_g.get_params()
+    arg_u, _ = mod_u.get_params()
+    for n in arg_g:
+        assert np.array_equal(arg_g[n].asnumpy(), arg_u[n].asnumpy()), n
+
+
+def test_bucketed_guard_rollback_and_lr_reduction(tmp_path):
+    """Divergence mid-run under bucketed dispatch: rollback restores the
+    newest known-good checkpoint through the shared state tree (opt
+    states included), reduces the shared optimizer's lr, and training
+    completes both epochs across both bucket shapes."""
+    keys = [10] * 4 + [6] * 4
+    prefix = str(tmp_path / "ck")
+    faults.inject("guard.loss_spike", nth=2)
+    g = TrainingGuard(patience=1, max_rollbacks=1, lr_factor=0.5)
+    mod, _ = _bucketed_guarded_fit(keys, 4, g, num_epoch=2, prefix=prefix,
+                                   every=4)
+    assert g.health.rollbacks == 1
+    assert abs(mod._base_module._optimizer.lr - 0.05) < 1e-12
+    # both epochs finished after the rollback (8 steps x 2 epochs)
+    assert mod._fused_host_step == 16
+    arg, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())
+
+
+def test_bucketed_guard_skip_storm_diverges(tmp_path):
+    """>= max_skips_per_window device-side skips inside one window is a
+    divergence signal on the bucketed path too."""
+    keys = [10] * 8
+    faults.inject("guard.grad_nan", nth=3, times=2)
+    g = TrainingGuard(max_skips_per_window=2, window=50)
+    with pytest.raises(TrainingDivergedError, match="skipped"):
+        _bucketed_guarded_fit(keys, 4, g)
+    assert g.health.skipped == 2

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""CI smoke gate for mxnet_tpu.autotune (docs/perf.md "Autotuning").
+
+Runs a tiny exhaustive grid over the zoo mlp on CPU and asserts the whole
+loop closes:
+
+1. the static pruner rejects at least one over-budget candidate
+   (``MXTPU_AUTOTUNE_BUDGET=128K`` makes the K=16 superbatch scan exceed
+   the budget) WITHOUT executing it;
+2. a winner is found whose measured score >= the built-in default's
+   (the default config is always trial #0) and is persisted to the
+   tuning DB;
+3. a FRESH ``Module.fit`` with no knob arguments resolves the winner's
+   knobs from the DB (obs-registry counter + compiled-scan cache key)
+   with ZERO extra retraces (``test_utils.assert_no_retrace`` over the
+   whole fit).
+
+Run via ci/autotune.sh (sets the temp DB path + budget).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BATCH = 48
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("MXTPU_AUTOTUNE_BUDGET", "128K")
+    os.environ.setdefault("MXTPU_AUTOTUNE_MEASURE", "6,18")
+    if not os.environ.get("MXTPU_AUTOTUNE_DB"):
+        sys.exit("autotune_gate: set MXTPU_AUTOTUNE_DB to a scratch path "
+                 "(the gate must not write the committed DB)")
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autotune, models
+    from mxnet_tpu.autotune.db import TuningDB
+    from mxnet_tpu.obs import REGISTRY
+    from mxnet_tpu.test_utils import assert_no_retrace
+    from mxnet_tpu.tracecheck import ZOO
+
+    # -- 1+2: the sweep — grid over {K, pipeline depth}, K=16 over-budget
+    res = autotune.tune(
+        model="mlp", objective="img_per_sec", budget=8, batch=BATCH,
+        write_db=True, rounds=2,
+        space=[autotune.Knob("steps_per_dispatch", (1, 2, 16)),
+               autotune.Knob("dispatch_pipeline", (1, 0))],
+        log=lambda m: print("autotune: %s" % m, file=sys.stderr))
+    counts = res["counts"]
+    if counts.get("pruned", 0) < 1:
+        sys.exit("autotune_gate FAIL: no candidate was statically pruned "
+                 "(expected K=16 over the 128K budget); counts %r"
+                 % counts)
+    for t in res["trials"]:
+        if t["knobs"]["steps_per_dispatch"] == 16 \
+                and t["status"] != "pruned":
+            sys.exit("autotune_gate FAIL: the over-budget K=16 candidate "
+                     "was %s, not pruned — it must never execute"
+                     % t["status"])
+    best, default = res["best"], res["default"]
+    if best is None:
+        sys.exit("autotune_gate FAIL: no successful trial (counts %r)"
+                 % counts)
+    if not (default and default["status"] == "ok"
+            and best["score"] >= default["score"]):
+        sys.exit("autotune_gate FAIL: winner %r does not reach the "
+                 "default config's score (%r)" % (best, default))
+    db = TuningDB.load(os.environ["MXTPU_AUTOTUNE_DB"])
+    key, entry, _ = db.lookup("train", symbol_sig=res["symbol_sig"],
+                              global_batch=BATCH)
+    if entry is None or entry["knobs"] != best["knobs"]:
+        sys.exit("autotune_gate FAIL: winner not persisted to the tuning "
+                 "DB (entry %r)" % (entry,))
+    print("autotune_gate: winner %r at %.1f %s (default %.1f), "
+          "%d pruned, persisted as %s"
+          % (best["knobs"], best["score"], res["unit"],
+             default["score"], counts["pruned"], key))
+
+    # -- 3: a fresh Module.fit resolves the winner from the DB with zero
+    # extra retraces (compiles are first-traces; any RETRACE EVENT or
+    # watched-cache growth inside the block fails)
+    sym = models.get_symbol("mlp", **ZOO["mlp"]["kwargs"])
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(BATCH * 4, 64)).astype(np.float32)
+    y = rng.integers(0, 4, BATCH * 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    before = REGISTRY.snapshot().get("autotune.db_resolutions", 0)
+    with assert_no_retrace(msg="DB-resolved fit"):
+        mod.fit(it, num_epoch=2,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    hits = REGISTRY.snapshot().get("autotune.db_resolutions", 0) - before
+    if hits != 1:
+        sys.exit("autotune_gate FAIL: expected exactly one obs-logged DB "
+                 "resolution in the fresh fit, saw %d" % hits)
+    k_best = best["knobs"]["steps_per_dispatch"]
+    if k_best > 1:
+        scans = list(mod._fused._jit_scan) if mod._fused else []
+        if not any(ck[1] == k_best for ck in scans):
+            sys.exit("autotune_gate FAIL: fresh fit did not train at the "
+                     "DB's K=%d (compiled scans: %r)" % (k_best, scans))
+    else:
+        # winner K=1 on this host: the fused per-step path carries it
+        if mod._fused is None:
+            sys.exit("autotune_gate FAIL: fresh fit never engaged the "
+                     "fused path")
+    print("autotune_gate: fresh Module.fit resolved %r from the DB with "
+          "zero extra retraces" % (best["knobs"],))
+    print("autotune gate PASS")
+
+
+if __name__ == "__main__":
+    main()
