@@ -39,6 +39,23 @@ program, zero collectives / efficiency 1.0 single-device). Needs N
 visible devices (on CPU:
 ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
 
+BENCH_LM=1 switches to the flagship-LM training bench (docs/perf.md
+"Flagship LM"): the transformer LM through the SAME fused K-step scan
+harness as the headline number, reporting steady-state tokens/sec + MFU
+(XLA cost-model FLOPs over the commscheck peak-FLOPs table; on CPU /
+unknown devices the roofline's nominal fallback, labeled
+peak_source=nominal-fallback), then one row per mesh spec in
+BENCH_LM_MESHES (";"-separated — default "data=2;seq=2;data=2,seq=2":
+data-parallel, ring-attention sequence-parallel, and the composed
+dp x sp mesh) at the SAME global batch, each with measured scaling
+efficiency plus the commscheck collective inventory and predicted
+efficiency. Knobs: BENCH_LM_BATCH (32), BENCH_LM_SEQ (128),
+BENCH_LM_VOCAB (1024), BENCH_LM_EMBED (256), BENCH_LM_LAYERS (4),
+BENCH_LM_HEADS (8), BENCH_LM_DTYPE (bfloat16), BENCH_LM_MESHES,
+BENCH_STEPS_PER_DISPATCH (default 4 in this mode; env > tuning DB >
+default), BENCH_ROUNDS. Multi-axis rows need the devices visible (on
+CPU: XLA_FLAGS=--xla_force_host_platform_device_count=N).
+
 BENCH_SERVE=1 switches to the serving latency bench (docs/serving.md):
 drive the dynamic batcher over the AOT shape-bucketed engine at a target
 QPS with open-loop arrivals and report request latency p50/p99 plus
@@ -963,6 +980,248 @@ def _dp_scaling_row(sym, dshape, batch, sdtype, cdtype, remat, spd, rounds):
     return row
 
 
+def lm_main():
+    """BENCH_LM=1: flagship transformer-LM training bench (docs/perf.md
+    "Flagship LM"): steady-state tokens/sec + MFU through the SAME fused
+    K-step scan harness as the ResNet headline (measure_scan_ips — one
+    methodology, so the LM and vision lines compare like with like),
+    then one row per mesh spec in BENCH_LM_MESHES — dp, sp (ring
+    attention over the 'seq' axis) and the composed dp x sp mesh — at
+    the SAME global batch, each with measured scaling efficiency AND the
+    commscheck roofline's prediction riding next to it, so the gap
+    between model and machine is visible per mesh."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import models, tracecheck
+    from mxnet_tpu.train_step import TrainStep
+    from mxnet_tpu.parallel.mesh import mesh_from_spec
+
+    batch = benv("BENCH_LM_BATCH")
+    seq = benv("BENCH_LM_SEQ")
+    vocab = benv("BENCH_LM_VOCAB")
+    embed = benv("BENCH_LM_EMBED")
+    layers = benv("BENCH_LM_LAYERS")
+    heads = benv("BENCH_LM_HEADS")
+    cdtype = benv("BENCH_LM_DTYPE")
+    rounds = benv("BENCH_ROUNDS")
+    mesh_specs = [m.strip() for m in benv("BENCH_LM_MESHES").split(";")
+                  if m.strip()]
+
+    sym = models.transformer(vocab_size=vocab, embed=embed,
+                             num_heads=heads, num_layers=layers,
+                             seq_len=seq)
+    # meshes carrying a 'seq' axis run RING attention (the flagship
+    # sequence-parallel mode: K/V rotate over the axis via ppermute)
+    # with the rank-3 preserve_shape head, instead of leaving the
+    # seq-sharded tensors to GSPMD's generic resharding — same math,
+    # but the measured collectives are the ring's, and the head never
+    # merges the sharded batch x seq dims (no per-trip all-gather)
+    sym_ring = models.transformer(vocab_size=vocab, embed=embed,
+                                  num_heads=heads, num_layers=layers,
+                                  seq_len=seq, seq_parallel="ring",
+                                  preserve_shape=True)
+
+    # BENCH_STEPS_PER_DISPATCH resolution: env > tuning DB > mode default
+    # (4 — the LM bench IS the steady-state story), the same precedence
+    # chain as the headline bench, and the JSON line says which source won
+    from mxnet_tpu import autotune as _autotune
+    spd = benv("BENCH_STEPS_PER_DISPATCH", 4)
+    at_block = {"steps_per_dispatch": {
+        "value": spd,
+        "source": "env" if env_set("BENCH_STEPS_PER_DISPATCH")
+        else "default"}}
+    if at_block["steps_per_dispatch"]["source"] == "default":
+        db_key, db_knobs = _autotune.resolve_train_knobs(sym, batch)
+        if db_knobs and "steps_per_dispatch" in db_knobs:
+            spd = max(1, int(db_knobs["steps_per_dispatch"]))
+            at_block = {"steps_per_dispatch": {"value": spd,
+                                               "source": "db"},
+                        "db_entry": db_key,
+                        "db": _autotune.default_db_path()}
+            _autotune.note_db_resolution(None, "bench.py", db_key,
+                                         {"steps_per_dispatch": spd})
+    k = max(1, spd)
+
+    # every mesh spec is validated BEFORE the headline measurement
+    # (mesh_from_spec fails with the XLA_FLAGS recipe on a device
+    # shortfall; shard_superbatch names the failing axis + dimension on
+    # a divisibility miss at each row's build) — a misconfigured env
+    # must not discard minutes of already-measured throughput
+    meshes = [(spec, mesh_from_spec(spec)) for spec in mesh_specs]
+
+    rng = np.random.default_rng(0)
+    data_h = rng.integers(0, vocab, (batch, seq)).astype(np.float32)
+    label_h = rng.integers(0, vocab, (batch, seq)).astype(np.float32)
+    # keep measured *steps* roughly constant as K grows (as the headline
+    # bench does; the LM is heavier per step so the counts start lower)
+    n_short = max(2, (12 + k - 1) // k)
+    n_long = max(n_short + 3, (48 + k - 1) // k)
+
+    def measure(mesh):
+        """(samples/sec, TrainStep, scan struct-args) for one mesh. The
+        struct capture happens BEFORE measuring: the scan donates the
+        state buffers, and the analyzers need only shapes + shardings."""
+        from mxnet_tpu import commscheck
+        from mxnet_tpu.parallel.mesh import AXIS_SEQ
+        seq_mesh = mesh is not None and AXIS_SEQ in mesh.axis_names
+        s = sym_ring if seq_mesh else sym
+        # pos_embed rows live with their 'seq' shard (replicated, the
+        # naturally seq-sharded grad pays an all-gather every trip)
+        shardings = ({"pos_embed_weight":
+                      jax.sharding.PartitionSpec(AXIS_SEQ, None)}
+                     if seq_mesh else None)
+        step = TrainStep(
+            s, optimizer="sgd", learning_rate=0.1, momentum=0.9,
+            wd=1e-4, mesh=mesh, param_shardings=shardings,
+            compute_dtype=None if cdtype == "float32" else cdtype)
+        state = step.init({"data": (batch, seq)},
+                          {"softmax_label": (batch, seq)})
+        sb = step.shard_superbatch({
+            "data": np.stack([data_h] * k),
+            "softmax_label": np.stack([label_h] * k)})
+        args = commscheck.struct_args(
+            (state, sb, step._dispatch_key(),
+             jnp.zeros((k,), jnp.float32)))
+        ips = measure_scan_ips(step, state, sb, batch, k, n_short,
+                               n_long, rounds=rounds)
+        return ips, step, args
+
+    ips1, step1, args1 = measure(None)
+    if ips1 <= 0.0:
+        raise RuntimeError(
+            "LM benchmark produced no valid measurement (rounds=%d)"
+            % rounds)
+
+    # exact FLOPs from XLA's cost model on the SINGLE LM step (lowered
+    # from the captured structs — the live state is already donated; the
+    # scan lowers to a While whose body the cost model counts once, so
+    # the per-token figure must come from the per-step computation)
+    flops_per_sample = None
+    try:
+        state_s, sb_s, key_s, _lrs = args1
+        if batch not in step1._jit:
+            step1._jit[batch] = step1._build(batch)
+        step_args = (state_s,
+                     {n: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+                      for n, v in sb_s.items()},
+                     key_s, jax.ShapeDtypeStruct((), np.float32))
+        lowered = step1._jit[batch].lower(*step_args)
+        try:
+            ca = lowered.cost_analysis()
+        except Exception:
+            ca = None
+        if ca is None:  # pre-compile analysis unsupported on this backend
+            ca = lowered.compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        flops_per_sample = float(ca["flops"]) / batch
+    except Exception as exc:  # MFU is a headline metric: never drop silently
+        print("WARNING: cost analysis failed, no MFU emitted: %r" % exc,
+              file=sys.stderr)
+
+    # static memory + comms profile of the measured single-device scan
+    # (ONE extra compile shared by both analyzers, exactly as the
+    # headline bench does for its measured program)
+    mem = None
+    comms = None
+    compiled1 = None
+    try:
+        from mxnet_tpu import memcheck
+        compiled1 = step1._jit_scan[(batch, k)].lower(*args1).compile()
+        mem = memcheck.analyze_compiled(
+            compiled1, "bench-lm-scan", args=args1, donate_argnums=(0,))
+    except Exception as exc:  # the bench number must survive an analyzer bug
+        print("WARNING: memcheck analysis failed, no HBM fields emitted: "
+              "%r" % exc, file=sys.stderr)
+    try:
+        from mxnet_tpu import commscheck
+        if compiled1 is not None:
+            comms = commscheck.analyze_compiled(
+                compiled1, "bench-lm-scan", loop_trips=k)
+    except Exception as exc:
+        print("WARNING: commscheck analysis failed, no comms fields "
+              "emitted: %r" % exc, file=sys.stderr)
+
+    # per-mesh rows: SAME global batch, SAME harness; the sharded scan's
+    # comms audit (commscheck.analyze compiles from the captured sharded
+    # structs) puts the roofline prediction next to the measured ratio
+    rows = []
+    for spec, mesh in meshes:
+        ipsn, stepn, argsn = measure(mesh)
+        row = {
+            "mesh": spec,
+            "n_devices": int(np.prod(list(mesh.shape.values()))),
+            "tokens_per_sec": round(ipsn * seq, 1),
+            "samples_per_sec": round(ipsn, 2),
+            "scaling_efficiency": (round(ipsn / ips1, 3)
+                                   if ips1 > 0 else None),
+        }
+        try:
+            from mxnet_tpu import commscheck
+            rep = commscheck.analyze(
+                stepn._jit_scan[(batch, k)], argsn,
+                name="bench-lm-scan[%s]" % spec, mesh=mesh, loop_trips=k)
+            row["collective_count"] = rep.collective_count
+            row["collective_bytes"] = rep.collective_bytes
+            row["predicted_efficiency"] = (
+                None if rep.predicted_efficiency is None
+                else round(rep.predicted_efficiency, 3))
+        except Exception as exc:
+            print("WARNING: commscheck analysis failed for mesh %s, no "
+                  "comms fields emitted: %r" % (spec, exc),
+                  file=sys.stderr)
+        rows.append(row)
+
+    peak, kind = _peak_flops(jax.devices()[0])
+    peak_source = "spec"
+    if peak is None:
+        # CPU / unknown device: the commscheck roofline's documented
+        # nominal fallback, clearly labeled — an MFU against a guessed
+        # spec-sheet number would be misinformation, but the forced-host
+        # CI line still needs a deterministic utilization figure
+        from mxnet_tpu.commscheck import DEFAULT_PEAK_FLOPS_PER_S
+        peak, peak_source = DEFAULT_PEAK_FLOPS_PER_S, "nominal-fallback"
+    out = {
+        "metric": "lm_train_tokens_per_sec_b%d_s%d_%s_k%d"
+                  % (batch, seq, cdtype, k),
+        "value": round(ips1 * seq, 1),
+        "unit": "tokens/sec",
+        "samples_per_sec": round(ips1, 2),
+        "tokens_per_sample": seq,
+        "model": {"vocab_size": vocab, "embed": embed,
+                  "num_layers": layers, "num_heads": heads,
+                  "seq_len": seq, "batch": batch},
+        "steps_per_dispatch": k,
+        # unexpected jit-cache misses during the measured run — a retrace
+        # storm invalidates the steady-state number
+        "retraces": tracecheck.retrace_count(),
+    }
+    if mem is not None:
+        out["hbm_peak_bytes"] = mem.peak_bytes
+        out["temp_bytes"] = mem.temp_bytes
+        out["alias_bytes"] = mem.alias_bytes
+    if comms is not None:
+        out["collective_count"] = comms.collective_count
+        out["collective_bytes"] = comms.collective_bytes
+        out["predicted_efficiency"] = (
+            None if comms.predicted_efficiency is None
+            else round(comms.predicted_efficiency, 3))
+    if flops_per_sample:
+        out["gflop_per_token_xla"] = round(flops_per_sample / seq / 1e9, 4)
+        out["achieved_tflops"] = round(ips1 * flops_per_sample / 1e12, 4)
+        # MFU only for bf16 compute: the peak table is the bf16 peak,
+        # and fp32 runs against it would understate utilization
+        if peak and cdtype == "bfloat16":
+            out["mfu"] = round(ips1 * flops_per_sample / peak, 6)
+            out["device_kind"] = kind
+            out["peak_tflops_bf16"] = peak / 1e12
+            out["peak_source"] = peak_source
+    out["meshes"] = rows
+    out["autotune"] = at_block
+    out["obs"] = _obs_block()
+    print(json.dumps(out))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1219,6 +1478,8 @@ if __name__ == "__main__":
         zoo_dispatch_main()
     elif benv("BENCH_REAL_DATA"):
         realdata_main()
+    elif benv("BENCH_LM"):
+        lm_main()
     elif benv("BENCH_FLEET"):
         fleet_main()
     elif benv("BENCH_SERVE"):

@@ -1,0 +1,23 @@
+#!/bin/sh
+# CI gate: flagship LM train-to-serve (docs/perf.md "Flagship LM"). A
+# small transformer LM through Module.fit's fused K-step scan on the
+# FORCED-HOST dp2 x sp2 mesh (8 virtual CPU devices). Asserts:
+#   (a) multi-axis fit parity — final params match the single-device fit
+#       (the composed data x seq mesh changes the schedule, not the math),
+#   (b) MID-FIT hot reload — an epoch-end callback swaps live params into
+#       a serving DecodeLoop with ZERO recompiles and the greedy decode
+#       bitwise-identical to a fresh engine built from the same snapshot,
+#   (c) zero unexpected retraces across both fits,
+#   (d) zero analyzer findings: comms lints over the dp x sp scan program
+#       + memcheck.lint_resident_set over the co-resident train + serve
+#       program set (fused scan + every compiled serving bucket).
+#
+# The committed BENCH_lm_r16.json pins the measured tokens/sec + MFU
+# numbers; this is the works-everywhere correctness half of that gate.
+set -e
+cd "$(dirname "$0")/.."
+echo "ci/lm.sh: dp2 x sp2 LM fit parity + mid-fit hot reload"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+    python tools/lm_gate.py
+echo "lm PASS"

@@ -46,6 +46,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # drain+rejoin; zero failed/shed requests, per-class p99 cap, zero
 # static findings across every replica's program set
 ./ci/fleet.sh
+# flagship-LM gate (docs/perf.md "Flagship LM"): dp2 x sp2 ring-attention
+# fit parity vs single device, MID-FIT decode hot reload (zero recompiles,
+# bitwise vs a fresh engine), zero retraces, and zero analyzer findings
+# over the co-resident train + serve program set
+./ci/lm.sh
 # observability gate (docs/observability.md): fused fit + batcher serve
 # under MXTPU_TRACE=1 — Chrome-trace schema validation (stages present,
 # spans nested, dispatch/request IDs consistent), registry snapshot

@@ -59,6 +59,16 @@ BENCH_KNOBS = {k.name: k for k in [
     BenchKnob("BENCH_RD_QUALITY", "int", 90),
     BenchKnob("BENCH_RD_MODEL", "str", "resnet"),
     BenchKnob("BENCH_RD_MEASURE", "str", "12,60"),
+    # flagship-LM mode (docs/perf.md "Flagship LM")
+    BenchKnob("BENCH_LM", "flag", False),
+    BenchKnob("BENCH_LM_BATCH", "int", 32),
+    BenchKnob("BENCH_LM_SEQ", "int", 128),
+    BenchKnob("BENCH_LM_VOCAB", "int", 1024),
+    BenchKnob("BENCH_LM_EMBED", "int", 256),
+    BenchKnob("BENCH_LM_LAYERS", "int", 4),
+    BenchKnob("BENCH_LM_HEADS", "int", 8),
+    BenchKnob("BENCH_LM_DTYPE", "str", "bfloat16"),
+    BenchKnob("BENCH_LM_MESHES", "str", "data=2;seq=2;data=2,seq=2"),
     # serving latency mode
     BenchKnob("BENCH_SERVE", "flag", False),
     BenchKnob("BENCH_SERVE_MODEL", "str", "mlp"),

@@ -93,6 +93,25 @@ class Speedometer(object):
         except Exception:
             return 1.0
 
+    @staticmethod
+    def _tokens_per_sample(param):
+        """Label tokens per sample for the LM tokens/sec suffix, read from
+        the training module via ``param.locals['self']``
+        (``Module._speed_tokens_per_sample`` — the label's sequence dim).
+        Strictly per-run like ``_speed_scale``: score() streams, foreign
+        callback params and scalar-label models all return 1, so the
+        tokens/sec suffix can never leak from an LM run into a vision
+        run's lines (or vice versa) on a reused Speedometer."""
+        loc = getattr(param, "locals", None)
+        mod = loc.get("self") if isinstance(loc, dict) else None
+        tps = getattr(mod, "_speed_tokens_per_sample", None)
+        if not callable(tps):
+            return 1
+        try:
+            return max(1, int(tps()))
+        except Exception:
+            return 1
+
     def _window_for(self, name, source_obj, fn):
         """Get-or-create the :class:`~mxnet_tpu.obs.registry.Window` for
         (suffix, source identity). A NEW source object (a different run's
@@ -221,6 +240,12 @@ class Speedometer(object):
                 speed = ((count - self._fired) * self.batch_size
                          * self._speed_scale(param)
                          / (time.time() - self.tic))
+                # LM runs (sequence labels) get the tokens/sec reading on
+                # the SAME line: samples/sec stays the cross-model figure,
+                # tokens/sec is the flagship-LM headline unit
+                tps = self._tokens_per_sample(param)
+                tok = (" (%.1f tokens/sec)" % (speed * tps)
+                       if tps > 1 else "")
                 health = self._health_suffix(param) \
                     + self._pipeline_suffix(param) \
                     + self._data_suffix(param) \
@@ -231,12 +256,12 @@ class Speedometer(object):
                     for name, value in name_value:
                         logging.info(
                             "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                            "\tTrain-%s=%f%s", param.epoch, count, speed,
-                            name, value, health)
+                            "%s\tTrain-%s=%f%s", param.epoch, count, speed,
+                            tok, name, value, health)
                 else:
                     logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                        param.epoch, count, speed, health)
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s%s",
+                        param.epoch, count, speed, tok, health)
                 self._fired = count
                 self.tic = time.time()
         else:

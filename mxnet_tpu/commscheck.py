@@ -1065,6 +1065,36 @@ def sharded_programs(n_devices=8, k=2):
                   (st3, batch3, ts3._dispatch_key(),
                    _sds((), f32, NamedSharding(mesh3, P()))),
                   1, mesh3, mesh3))
+
+    # 4) the flagship-LM multi-axis fused K-step scan (docs/perf.md
+    # "Flagship LM"): the dp x sp ring transformer with the rank-3
+    # preserve_shape head through the scan path Module.fit dispatches —
+    # in-scan grad psum over 'data' composed with the ppermute ring over
+    # 'seq', carry pinned by the jit-root state out_shardings, and no
+    # batch x seq dim merge anywhere (the flat head's reshape would pay
+    # an all-gather over 'seq' every trip)
+    sym4 = models.transformer(vocab_size=64, embed=32, num_heads=4,
+                              num_layers=2, seq_len=seq_len,
+                              seq_parallel="ring", preserve_shape=True)
+    with MeshScope(mesh3):
+        # pos_embed rows live with their 'seq' shard — replicated, the
+        # naturally seq-sharded grad would all-gather every trip in the
+        # optimizer update
+        ts4 = TrainStep(sym4, optimizer="sgd", learning_rate=0.1,
+                        mesh=mesh3,
+                        param_shardings={"pos_embed_weight":
+                                         P("seq", None)})
+        st4 = state_structs(ts4, {"data": (b3, seq_len)},
+                            {"softmax_label": (b3, seq_len)})
+        scan4 = ts4._build_scan(b3, k, state=st4)
+    sbsh = NamedSharding(mesh3, P(None, "data", "seq"))
+    sb4 = {"data": _sds((k, b3, seq_len), f32, sbsh),
+           "softmax_label": _sds((k, b3, seq_len), f32, sbsh)}
+    progs.append(("dp%dxsp%d/transformer-ring/scan[k=%d]" % (dp, sp, k),
+                  scan4,
+                  (st4, sb4, ts4._dispatch_key(),
+                   _sds((k,), f32, NamedSharding(mesh3, P()))),
+                  k, mesh3, mesh3))
     return progs
 
 

@@ -408,16 +408,25 @@ class Perplexity(EvalMetric):
             loss = jnp.zeros((), jnp.float32)
             num = jnp.zeros((), jnp.float32)
             for o, l in zip(outs, labels):
-                li = l.reshape(-1).astype(jnp.int32)
-                flat = o.reshape(-1, o.shape[-1]).astype(jnp.float32)
-                probs = jnp.take_along_axis(flat, li[:, None], axis=1)[:, 0]
+                # rank-agnostic: index the LAST dim in place — label
+                # (b, s) with pred (b, s, v) [preserve_shape LM head]
+                # stays UNRESHAPED (a flatten would merge sharded
+                # batch x seq dims and pay an all-gather every scan trip
+                # on a composed mesh); only a label whose layout differs
+                # from the pred's (e.g. (b, s) vs flat (b*s, v)) is
+                # rearranged to match
+                if l.shape != o.shape[:-1]:
+                    l = l.reshape(o.shape[:-1])
+                li = l.astype(jnp.int32)
+                probs = jnp.take_along_axis(
+                    o.astype(jnp.float32), li[..., None], axis=-1)[..., 0]
                 if ignore is not None:
                     ign = (li == jnp.int32(ignore)).astype(jnp.float32)
                     num = num - jnp.sum(ign)
                     probs = probs * (jnp.float32(1.0) - ign) + ign
                 loss = loss - jnp.sum(
                     jnp.log(jnp.maximum(jnp.float32(1e-10), probs)))
-                num = num + jnp.float32(li.shape[0])
+                num = num + jnp.float32(li.size)
             ppl = jnp.where(num > 0, jnp.exp(loss / num) * num,
                             jnp.zeros((), jnp.float32))
             return (ppl, loss, num)
@@ -542,6 +551,7 @@ class CrossEntropy(EvalMetric):
             label = _np(label)
             pred = _np(pred)
             label = label.ravel()
+            pred = pred.reshape(-1, pred.shape[-1])  # rank-3 LM heads
             assert label.shape[0] == pred.shape[0]
             prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
             self.sum_metric += (-numpy.log(prob + self.eps)).sum()
@@ -555,21 +565,26 @@ class CrossEntropy(EvalMetric):
         if not out_shapes or len(out_shapes) != len(label_shapes):
             return None
         for o, l in zip(out_shapes, label_shapes):
-            if len(o) != 2 or _prod(l) != o[0]:
+            if len(o) < 2 or _prod(l) != _prod(o) // o[-1]:
                 return None
         eps = float(self.eps)
-        n = sum(o[0] for o in out_shapes)
+        n = sum(_prod(o) // o[-1] for o in out_shapes)
 
         def step_sums(outs, labels):
             import jax.numpy as jnp
             loss = jnp.zeros((), jnp.float32)
             for o, l in zip(outs, labels):
-                li = l.reshape(-1).astype(jnp.int32)
-                # take_along_axis, NOT o[arange, li]: keeps the batch dims
-                # aligned so the gather stays per-shard under a data mesh
-                # (see train_step._metric_step_sums)
-                p = jnp.take_along_axis(o, li[:, None], axis=1)[:, 0] \
-                    .astype(jnp.float32)
+                # take_along_axis over the LAST dim, NOT o[arange, li]:
+                # keeps the batch dims aligned so the gather stays
+                # per-shard under a data mesh (see
+                # train_step._metric_step_sums); rank-agnostic like
+                # Perplexity's — a rank-3 preserve_shape LM head never
+                # flattens its sharded batch x seq dims
+                if l.shape != o.shape[:-1]:
+                    l = l.reshape(o.shape[:-1])
+                li = l.astype(jnp.int32)
+                p = jnp.take_along_axis(
+                    o.astype(jnp.float32), li[..., None], axis=-1)[..., 0]
                 loss = loss + jnp.sum(-jnp.log(p + jnp.float32(eps)))
             return (loss, jnp.float32(n))
 

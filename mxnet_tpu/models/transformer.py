@@ -4,52 +4,159 @@ Supersedes the reference's model-parallel LSTM as the long-sequence story
 (ref pattern being replaced: example/model-parallel-lstm/lstm.py:48-112;
 SURVEY.md §5): blockwise attention on one chip, ring or Ulysses sequence
 parallelism over the mesh 'seq' axis (``seq_parallel`` attr on
-MultiHeadAttention), data/tensor parallelism via the ambient mesh.
+MultiHeadAttention), data/tensor parallelism via the ambient mesh, and —
+with ``stack_layers=True`` — pipeline parallelism over the 'pipe' axis
+(the TransformerStack op stacks per-layer weights along a leading stage
+dimension for the GPipe schedule in parallel/pipeline.py).
 
 Pre-LN blocks: x + MHA(LN(x)); x + FFN(LN(x)); loss is per-position
 softmax cross-entropy over the vocabulary.
 """
 from .. import symbol as sym
+from ..base import MXNetError
 
 
 def _ffn(x, embed, hidden, name):
-    h = sym.Reshape(data=x, shape=(-1, embed))
-    h = sym.FullyConnected(data=h, num_hidden=hidden, name=name + "_fc1")
+    # flatten=False keeps (b, s, e) through both projections: the old
+    # Reshape pair merged the batch and seq dims, which forces an
+    # all-gather over 'seq' every scan trip on a composed data x seq mesh
+    h = sym.FullyConnected(data=x, num_hidden=hidden, flatten=False,
+                           name=name + "_fc1")
     h = sym.Activation(data=h, act_type="relu")
-    h = sym.FullyConnected(data=h, num_hidden=embed, name=name + "_fc2")
+    h = sym.FullyConnected(data=h, num_hidden=embed, flatten=False,
+                           name=name + "_fc2")
     return h
+
+
+def _validate(vocab_size, embed, num_heads, num_layers, seq_len,
+              ffn_hidden, max_seq_len, seq_parallel, block_size, dropout,
+              stack_layers):
+    """Build-time configuration validation with actionable errors — the
+    training-side twin of DecodeLoop's serve-time rejections (a config
+    that would silently clamp positions or gather garbage embeddings must
+    fail HERE, not as a partitioner shape complaint three layers down)."""
+    if vocab_size < 2:
+        raise MXNetError(
+            "transformer: vocab_size must be >= 2, got %d — the LM head "
+            "and embedding table need a real vocabulary" % vocab_size)
+    if seq_len < 1:
+        raise MXNetError("transformer: seq_len must be >= 1, got %d"
+                         % seq_len)
+    if num_layers < 1:
+        raise MXNetError("transformer: num_layers must be >= 1, got %d"
+                         % num_layers)
+    if embed % num_heads:
+        raise MXNetError(
+            "transformer: embed %d %% num_heads %d != 0 — the head dim "
+            "must be integral (pick embed a multiple of num_heads)"
+            % (embed, num_heads))
+    if ffn_hidden < 1:
+        raise MXNetError("transformer: ffn_hidden must be >= 1, got %d"
+                         % ffn_hidden)
+    if max_seq_len is not None and seq_len > max_seq_len:
+        raise MXNetError(
+            "transformer: seq_len %d exceeds the positional embedding "
+            "table (%d rows) — positions past it would be silently "
+            "clamped at serve time; raise max_seq_len or shorten seq_len"
+            % (seq_len, max_seq_len))
+    if block_size < 0 or block_size > seq_len:
+        raise MXNetError(
+            "transformer: block_size %d is outside [0, seq_len=%d] — 0 "
+            "disables blocking, otherwise blocks must fit the sequence"
+            % (block_size, seq_len))
+    if block_size and seq_len % block_size:
+        raise MXNetError(
+            "transformer: seq_len %d %% block_size %d != 0 — blockwise "
+            "attention needs equal blocks" % (seq_len, block_size))
+    if not 0.0 <= dropout < 1.0:
+        raise MXNetError("transformer: dropout must be in [0, 1), got %g"
+                         % dropout)
+    if stack_layers and seq_parallel:
+        raise MXNetError(
+            "transformer: stack_layers=True cannot combine with "
+            "seq_parallel=%r — a pipeline stage body already runs inside "
+            "shard_map, where the nested seq-parallel shard_map cannot "
+            "be formed; pick 'pipe' OR 'seq' for the layer stack"
+            % seq_parallel)
+    if stack_layers and dropout > 0:
+        raise MXNetError(
+            "transformer: stack_layers=True does not support dropout — "
+            "the stacked stage body is shared across layers; train the "
+            "per-layer build or drop dropout")
 
 
 def get_symbol(vocab_size=256, embed=128, num_heads=4, num_layers=2,
                seq_len=128, ffn_hidden=None, causal=True, seq_parallel="",
-               block_size=0, dropout=0.0, **kwargs):
+               block_size=0, dropout=0.0, max_seq_len=None,
+               stack_layers=False, num_microbatches=0,
+               preserve_shape=False, **kwargs):
     """Returns the LM symbol; data (batch, seq) int tokens, label
-    (batch, seq) next-token ids."""
+    (batch, seq) next-token ids.
+
+    ``preserve_shape=True`` keeps the head rank-3 — (batch, seq, vocab)
+    probabilities, label consumed as (batch, seq) — instead of the
+    historical flattened (batch*seq, vocab) output: on a composed
+    data x seq mesh the flatten merges two sharded dims, which costs an
+    all-gather over 'seq' EVERY scan trip; the rank-3 head is
+    gather-free. Metrics handle both layouts.
+
+    ``max_seq_len`` decouples the positional-embedding table from the
+    training window (the table gets ``max_seq_len`` rows; serve-time
+    decode may then run past ``seq_len`` up to the table, mirroring
+    DecodeLoop's max_len bound). ``stack_layers=True`` builds the layer
+    stack as ONE TransformerStack op over (num_layers, ...) stacked
+    weights — under an ambient mesh with a 'pipe' axis the stack runs
+    the GPipe schedule (``num_microbatches`` 0 = one per stage).
+    Token ids must lie in [0, vocab_size): out-of-range ids gather
+    garbage embeddings silently on TPU — validate the tokenizer output
+    (DecodeLoop.generate rejects them at serve time)."""
     ffn_hidden = ffn_hidden or 4 * embed
+    _validate(vocab_size, embed, num_heads, num_layers, seq_len,
+              ffn_hidden, max_seq_len, seq_parallel, block_size, dropout,
+              stack_layers)
+    table_rows = max_seq_len if max_seq_len is not None else seq_len
     data = sym.Variable("data")
-    pos = sym.Variable("pos_embed_weight", shape=(seq_len, embed))
+    pos = sym.Variable("pos_embed_weight", shape=(table_rows, embed))
+    if table_rows != seq_len:
+        pos = sym.slice_axis(pos, axis=0, begin=0, end=seq_len)
     tok = sym.Embedding(data=data, input_dim=vocab_size, output_dim=embed,
                         name="tok_embed")
     x = sym.broadcast_add(tok, sym.expand_dims(pos, axis=0))
-    for i in range(num_layers):
-        name = "layer%d" % i
-        a = sym.LayerNorm(data=x, name=name + "_ln1")
-        a = sym.MultiHeadAttention(data=a, num_heads=num_heads,
-                                   causal=causal, seq_parallel=seq_parallel,
-                                   block_size=block_size,
-                                   name=name + "_attn")
-        if dropout > 0:
-            a = sym.Dropout(data=a, p=dropout)
-        x = x + a
-        f = sym.LayerNorm(data=x, name=name + "_ln2")
-        f = _ffn(f, embed, ffn_hidden, name + "_ffn")
-        f = sym.Reshape(data=f, shape=(-1, seq_len, embed))
-        if dropout > 0:
-            f = sym.Dropout(data=f, p=dropout)
-        x = x + f
+    if stack_layers:
+        x = sym.TransformerStack(
+            data=x, num_layers=num_layers, num_heads=num_heads,
+            ffn_hidden=ffn_hidden, causal=causal, block_size=block_size,
+            num_microbatches=num_microbatches, name="stack")
+    else:
+        for i in range(num_layers):
+            name = "layer%d" % i
+            a = sym.LayerNorm(data=x, name=name + "_ln1")
+            a = sym.MultiHeadAttention(data=a, num_heads=num_heads,
+                                       causal=causal,
+                                       seq_parallel=seq_parallel,
+                                       block_size=block_size,
+                                       name=name + "_attn")
+            if dropout > 0:
+                a = sym.Dropout(data=a, p=dropout)
+            x = x + a
+            f = sym.LayerNorm(data=x, name=name + "_ln2")
+            f = _ffn(f, embed, ffn_hidden, name + "_ffn")
+            if dropout > 0:
+                f = sym.Dropout(data=f, p=dropout)
+            x = x + f
     x = sym.LayerNorm(data=x, name="final_ln")
+    label = sym.Variable("softmax_label")
+    if preserve_shape:
+        # rank-3 head: (b, s, vocab) probabilities over the last dim with
+        # the (b, s) label consumed directly — no batch x seq dim merge
+        # anywhere, so the composed data x seq program carries no
+        # resharding gather in its compiled loop (the flat default below
+        # keeps the historical (b*s, vocab) output for existing callers)
+        logits = sym.FullyConnected(data=x, num_hidden=vocab_size,
+                                    flatten=False, name="lm_head")
+        return sym.SoftmaxOutput(data=logits, label=label,
+                                 preserve_shape=True, name="softmax")
     x = sym.Reshape(data=x, shape=(-1, embed))
     logits = sym.FullyConnected(data=x, num_hidden=vocab_size, name="lm_head")
-    label = sym.Variable("softmax_label")
     label = sym.Reshape(data=label, shape=(-1,))
     return sym.SoftmaxOutput(data=logits, label=label, name="softmax")

@@ -56,8 +56,24 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, mesh_axes=None, param_shardings=None):
         super().__init__(logger=logger)
+        # multi-axis mesh training (docs/perf.md "Flagship LM"):
+        # ``mesh_axes`` — "data=2,seq=2" / {"data": 2, "pipe": 2} — makes
+        # the fused step run over a named multi-axis mesh instead of the
+        # contexts-derived 1-axis 'data' mesh; MXTPU_LM_MESH supplies the
+        # same spec from the environment (explicit arg wins).
+        # ``param_shardings`` maps parameter names to PartitionSpecs
+        # (e.g. the stack_* stacked weights onto P('pipe')).
+        if mesh_axes is None:
+            from ..base import env_str
+            mesh_axes = env_str("MXTPU_LM_MESH") or None
+        if mesh_axes is not None:
+            from ..parallel.mesh import parse_mesh_axes
+            mesh_axes = parse_mesh_axes(mesh_axes)
+        self._mesh_axes = mesh_axes
+        self._param_shardings = dict(param_shardings or {})
+        self._override_mesh_cache = None
         if context is None:
             from ..context import current_context
             from .. import engine as _engine
@@ -526,13 +542,43 @@ class Module(BaseModule):
         return (self._kvstore is not None and "dist" in self._kvstore.type
                 and getattr(self._kvstore, "num_workers", 1) > 1)
 
+    def _override_mesh(self):
+        """The multi-axis mesh requested via ``mesh_axes`` /
+        ``MXTPU_LM_MESH``, built lazily over the process's devices (None
+        when no spec was given). Replaces the contexts-derived 1-axis
+        'data' mesh for the fused step."""
+        if self._mesh_axes is None:
+            return None
+        if self._override_mesh_cache is None:
+            from ..parallel.mesh import mesh_from_spec
+            self._override_mesh_cache = mesh_from_spec(self._mesh_axes)
+        return self._override_mesh_cache
+
+    def _fused_mesh(self):
+        """The mesh the fused step will (or does) run over: the explicit
+        multi-axis override when given, else the executor group's
+        contexts-derived 'data' mesh."""
+        om = self._override_mesh()
+        if om is not None:
+            return om
+        return (self._exec_group._mesh
+                if self._exec_group is not None else None)
+
     def _build_fused(self):
         from ..train_step import TrainStep
         eg = self._exec_group
         frozen = [n for n in eg.param_names
                   if eg.grad_req.get(n, "null") == "null"]
         mesh = eg._mesh
-        if self._is_dist_kvstore():
+        om = self._override_mesh()
+        if om is not None:
+            if self._is_dist_kvstore():
+                raise MXNetError(
+                    "mesh_axes/MXTPU_LM_MESH cannot combine with a dist "
+                    "kvstore — the multi-axis mesh is single-controller; "
+                    "use the global 'data' mesh for dist workers")
+            mesh = om
+        elif self._is_dist_kvstore():
             # dist_sync INSIDE the fused step: the batch shards over a
             # global mesh spanning every worker process and XLA places the
             # gradient psum over DCN/ICI exactly where the reference ran
@@ -543,7 +589,8 @@ class Module(BaseModule):
         self._fused = TrainStep(
             self._symbol, data_names=eg.data_names,
             label_names=eg.label_names, optimizer=self._optimizer,
-            mesh=mesh, frozen_param_names=frozen)
+            mesh=mesh, param_shardings=self._param_shardings or None,
+            frozen_param_names=frozen)
         self._fused_state = self._seed_fused_state()
         self._fused_params_stale = False
         self._fused_metrics_ok = self._infer_fused_metrics_ok()
@@ -629,14 +676,30 @@ class Module(BaseModule):
                         % (getattr(eval_metric, "name", eval_metric),
                            shapes))
             self._fused_metric_spec = spec
-        mesh = self._exec_group._mesh
+        mesh = self._fused_mesh()
         if mesh is not None:
-            from ..parallel.mesh import data_axis_size
+            from ..parallel.mesh import data_axis_size, AXIS_SEQ
+            explicit = self._override_mesh() is not None
             n = data_axis_size(mesh)
             if self._exec_group.batch_size % n:
-                return (False, "global batch %d does not divide the %d-way "
-                        "'data' mesh axis — the sharded scan needs equal "
-                        "per-chip shards" % (self._exec_group.batch_size, n))
+                why = ("global batch %d does not divide the %d-way "
+                       "'data' mesh axis — the sharded scan needs equal "
+                       "per-chip shards" % (self._exec_group.batch_size, n))
+                if explicit:
+                    # the user ASKED for this mesh: a silent fall-back to
+                    # per-step single-device training would train the
+                    # wrong program — fail with the axis named
+                    raise MXNetError("Module(mesh_axes=...): " + why)
+                return (False, why)
+            sp = data_axis_size(mesh, AXIS_SEQ)
+            if sp > 1:
+                for name, shape in self._bound_shapes()[0].items():
+                    if len(shape) >= 2 and shape[1] % sp:
+                        raise MXNetError(
+                            "Module(mesh_axes=...): bound input %r "
+                            "sequence dim %d does not divide the %d-way "
+                            "'seq' mesh axis — pad the sequence or pick a "
+                            "divisible seq_len" % (name, shape[1], sp))
         return True, None
 
     def _superbatch_sharding(self):
@@ -647,13 +710,21 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
         "Data-parallel scaling"). None when the fused path runs without a
         single-process mesh (single device, dist workers, per-step
         configs)."""
-        mesh = self._exec_group._mesh if self._exec_group is not None \
-            else None
+        mesh = self._fused_mesh()
         if mesh is None or self._is_dist_kvstore():
             return None
-        from ..parallel.mesh import is_multiprocess, superbatch_sharding
+        from ..parallel.mesh import (is_multiprocess, superbatch_sharding,
+                                     AXIS_SEQ)
         if is_multiprocess(mesh):
             return None
+        if AXIS_SEQ in mesh.axis_names:
+            # the seq-aware sharding splits dim 2 of every stacked slot, so
+            # it is only safe when every bound array is rank >= 2 (LM data
+            # AND label are (batch, seq))
+            shapes = list(self._bound_shapes()[0].values())
+            if shapes and all(len(s) >= 2 for s in shapes):
+                return superbatch_sharding(mesh, seq=True)
+            return superbatch_sharding(mesh)
         return superbatch_sharding(mesh)
 
     def _global_batch_scale(self):
@@ -669,6 +740,21 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
             if is_multiprocess(self._fused.mesh):
                 import jax
                 return int(jax.process_count())
+        return 1
+
+    def _speed_tokens_per_sample(self):
+        """Tokens per sample for throughput reporting: the product of the
+        bound label's non-batch dims (an LM label is (batch, seq) next-token
+        ids, so seq tokens land per sample). 1 for rank-1 labels —
+        Speedometer only appends a tokens/sec figure when this exceeds 1,
+        so classification runs keep their samples/sec-only line."""
+        try:
+            _, lshapes, _ = self._bound_shapes()
+            if len(lshapes) == 1 and len(lshapes[0]) > 1:
+                import numpy as _np
+                return int(_np.prod(lshapes[0][1:]))
+        except Exception:
+            pass
         return 1
 
     def _can_guard(self):
@@ -754,13 +840,18 @@ SuperBatchIter` so stacked superbatches LAND per-chip sharded (step axis
         eg = self._exec_group
         from ..parallel.mesh import is_multiprocess, local_view
         multiproc = is_multiprocess(self._fused.mesh)
+        # the multi-axis override mesh is NOT the executor group's mesh:
+        # eg._shard_batch would land dim-0-only shards on the wrong mesh,
+        # so route through TrainStep.shard_batch (which also splits the
+        # token dim over 'seq')
+        route = multiproc or self._override_mesh() is not None
         batch = {}
         for name, value in zip(eg.data_names, data_batch.data):
-            batch[name] = value if multiproc else eg._shard_batch(value)
+            batch[name] = value if route else eg._shard_batch(value)
         if eg.label_names and data_batch.label:
             for name, value in zip(eg.label_names, data_batch.label):
-                batch[name] = value if multiproc else eg._shard_batch(value)
-        if multiproc:
+                batch[name] = value if route else eg._shard_batch(value)
+        if route:
             # each worker contributes its local shard of the global batch
             import numpy as _np
             batch = self._fused.shard_batch(

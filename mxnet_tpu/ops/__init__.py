@@ -8,6 +8,7 @@ from . import rnn_op        # noqa: F401  (registers the fused RNN op)
 from . import spatial       # noqa: F401  (registers spatial ops)
 from . import contrib       # noqa: F401  (registers contrib/SSD/CTC ops)
 from . import attention     # noqa: F401  (registers MultiHeadAttention/LayerNorm)
+from . import transformer_stack  # noqa: F401  (registers TransformerStack)
 from . import dtype_rules   # noqa: F401  (attaches per-op InferType rules)
 
 get = registry.get

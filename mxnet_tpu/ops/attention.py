@@ -83,6 +83,19 @@ def _attend(q, k, v, causal, block, seq_par):
                 "with a 'seq' axis (parallel.mesh.MeshScope / TrainStep "
                 "mesh)" % seq_par)
         from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import check_axis_divides
+        b, h, s, _ = q.shape
+        # divisibility prechecks that NAME the failing axis (the shard_map
+        # partitioner's complaint would not): seq dim over 'seq', batch
+        # over 'data' when composed, heads over 'seq' for Ulysses' head
+        # all-to-all
+        check_axis_divides(mesh, "seq", s,
+                           "MultiHeadAttention: sequence dim")
+        check_axis_divides(mesh, "data", b, "MultiHeadAttention: batch dim")
+        if seq_par == "ulysses":
+            check_axis_divides(
+                mesh, "seq", h,
+                "MultiHeadAttention(seq_parallel='ulysses'): num_heads")
         # batch stays sharded over 'data' when the mesh carries both axes
         # (dp x sp); heads/dim replicated — ring/Ulysses communicate over
         # 'seq' only

@@ -39,23 +39,34 @@ def _fc_inputs(attrs):
 def _fc_infer(attrs, in_shapes):
     num_hidden = attr_int(attrs["num_hidden"])
     no_bias = attr_bool(attrs.get("no_bias", False), False)
+    flatten = attr_bool(attrs.get("flatten", True), True)
     data = in_shapes[0]
     if data is None:
         raise MXNetError("FullyConnected: data shape required")
-    in_units = 1
-    for d in data[1:]:
-        in_units *= d
+    if flatten:
+        in_units = 1
+        for d in data[1:]:
+            in_units *= d
+        out = (data[0], num_hidden)
+    else:
+        # ref flatten=False: contract the LAST dim only, keep the leading
+        # dims — (b, s, e) @ (h, e)^T -> (b, s, h). Under a composed
+        # data x seq mesh this never merges two sharded dims, so no
+        # resharding gather rides into the compiled loop
+        in_units = data[-1]
+        out = tuple(data[:-1]) + (num_hidden,)
     shapes = [tuple(data), (num_hidden, in_units)]
     if not no_bias:
         shapes.append((num_hidden,))
-    return shapes, [(data[0], num_hidden)], []
+    return shapes, [out], []
 
 
 def _fc(op_ctx, attrs, inputs, aux):
     num_hidden = attr_int(attrs["num_hidden"])
     no_bias = attr_bool(attrs.get("no_bias", False), False)
+    flatten = attr_bool(attrs.get("flatten", True), True)
     data = inputs[0]
-    x = data.reshape(data.shape[0], -1)
+    x = data.reshape(data.shape[0], -1) if flatten else data
     w = inputs[1]
     y = jnp.dot(x, w.T)
     if not no_bias:
@@ -550,17 +561,28 @@ def _softmax_out_infer(attrs, in_shapes):
     if data is None:
         raise MXNetError("SoftmaxOutput: data shape required")
     multi = attr_bool(attrs.get("multi_output", False), False)
-    label = (data[0],) + tuple(data[2:]) if multi else (data[0],)
+    preserve = attr_bool(attrs.get("preserve_shape", False), False)
+    if preserve:
+        label = tuple(data[:-1])
+    elif multi:
+        label = (data[0],) + tuple(data[2:])
+    else:
+        label = (data[0],)
     return [tuple(data), label], [tuple(data)], []
 
 
 @functools.lru_cache(maxsize=None)
 def _make_softmax_output(grad_scale, ignore_label, use_ignore, multi_output,
-                         normalization):
+                         normalization, preserve_shape=False):
     """custom_vjp closure over the static attrs (jax.custom_vjp args must all
     be jax types)."""
 
     def _softmax_fwd(data):
+        if preserve_shape:
+            # ref preserve_shape: softmax over the LAST dim, shape kept —
+            # (b, s, v) logits with (b, s) labels never flatten, so a
+            # data x seq sharded LM head stays gather-free
+            return jax.nn.softmax(data, axis=-1)
         if multi_output:
             return jax.nn.softmax(data, axis=1)
         return jax.nn.softmax(data.reshape(data.shape[0], -1),
@@ -576,7 +598,15 @@ def _make_softmax_output(grad_scale, ignore_label, use_ignore, multi_output,
 
     def bwd(res, g):
         out, label = res
-        if multi_output:
+        if preserve_shape:
+            lab = label.astype(jnp.int32)
+            oh = jax.nn.one_hot(lab, out.shape[-1], dtype=out.dtype)
+            grad = out - oh
+            valid = jnp.ones(lab.shape, out.dtype)
+            if use_ignore:
+                valid = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad * valid[..., None]
+        elif multi_output:
             lab = label.astype(jnp.int32)
             oh = jax.nn.one_hot(lab, out.shape[1], axis=1, dtype=out.dtype)
             grad = out - oh
@@ -610,8 +640,9 @@ def _softmax_output(op_ctx, attrs, inputs, aux):
     il = attr_float(attrs.get("ignore_label", -1.0), -1.0)
     ui = attr_bool(attrs.get("use_ignore", False), False)
     mo = attr_bool(attrs.get("multi_output", False), False)
+    ps = attr_bool(attrs.get("preserve_shape", False), False)
     norm = attr_str(attrs.get("normalization", "null"), "null")
-    fn = _make_softmax_output(gs, il, ui, mo, norm)
+    fn = _make_softmax_output(gs, il, ui, mo, norm, preserve_shape=ps)
     return (fn(inputs[0], inputs[1]),)
 
 

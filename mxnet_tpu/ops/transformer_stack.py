@@ -1,0 +1,140 @@
+"""TransformerStack: the whole pre-LN layer stack as ONE op over stacked
+parameters — the pipeline-parallel flagship surface.
+
+The per-layer symbol composition (models/transformer.py default) gives
+every layer its own parameter Variables, which is the right shape for
+data/tensor/sequence parallelism but cannot pipeline: GPipe needs every
+stage to share one structure with parameters STACKED along a leading
+stage dimension (parallel/pipeline.py). This op is that formulation —
+each weight arrives as an (L, ...) stack, and the layer loop dispatches
+on the ambient mesh:
+
+* mesh with a 'pipe' axis: ``parallel.pipeline.pipeline_apply`` runs the
+  GPipe schedule — layers fold onto stages ((L/S per stage), activations
+  hop stages over ppermute, batch optionally stays sharded over 'data'
+  (dp x pipe composition);
+* otherwise: one ``lax.scan`` over the L layers (same numerics, single
+  compiled layer body — also what keeps compile time flat as L grows).
+
+Attention inside a stage is the single-chip blockwise core
+(parallel/ring.py): a pipeline stage body already runs inside shard_map,
+where a nested seq-parallel shard_map cannot be formed — get_symbol
+refuses the stacked+seq_parallel combination up front.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_int, MXNetError
+from .registry import OpDef, register_def
+
+#: stacked-parameter input order (leading dim L on every non-data input)
+STACK_INPUTS = ("data", "ln1_gamma", "ln1_beta", "qkv_weight", "qkv_bias",
+                "out_weight", "out_bias", "ln2_gamma", "ln2_beta",
+                "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias")
+
+
+def _stack_attrs(attrs):
+    num_layers = attr_int(attrs["num_layers"])
+    num_heads = attr_int(attrs["num_heads"])
+    ffn_hidden = attr_int(attrs["ffn_hidden"])
+    causal = attr_bool(attrs.get("causal", True), True)
+    block = attr_int(attrs.get("block_size", 0), 0)
+    micro = attr_int(attrs.get("num_microbatches", 0), 0)
+    return num_layers, num_heads, ffn_hidden, causal, block, micro
+
+
+def _stack_infer(attrs, in_shapes):
+    L, num_heads, H, _, _, _ = _stack_attrs(attrs)
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("TransformerStack: data shape required")
+    if len(data) != 3:
+        raise MXNetError("TransformerStack: data must be "
+                         "(batch, seq, embed), got %s" % (data,))
+    e = data[2]
+    if e % num_heads:
+        raise MXNetError("TransformerStack: embed %d %% num_heads %d != 0"
+                         % (e, num_heads))
+    shapes = [tuple(data),
+              (L, e), (L, e),               # ln1 gamma/beta
+              (L, 3 * e, e), (L, 3 * e),    # qkv
+              (L, e, e), (L, e),            # out proj
+              (L, e), (L, e),               # ln2 gamma/beta
+              (L, H, e), (L, H),            # ffn fc1
+              (L, e, H), (L, e)]            # ffn fc2
+    return shapes, [tuple(data)], []
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def _one_layer(p, x, num_heads, causal, block):
+    """One pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)) — the same math
+    as the per-layer symbol composition (LayerNorm + MultiHeadAttention +
+    FC), so stacked and unstacked builds agree for equal weights
+    (tests/test_lm_flagship.py pins the parity)."""
+    from ..parallel import ring as _ring
+    (ln1_g, ln1_b, wqkv, bqkv, wout, bout,
+     ln2_g, ln2_b, w1, b1, w2, b2) = p
+    b, s, e = x.shape
+    d = e // num_heads
+    a = _layer_norm(x, ln1_g, ln1_b)
+    qkv = jnp.einsum("bse,fe->bsf", a, wqkv) + bqkv
+    qkv = qkv.reshape(b, s, 3, num_heads, d)
+    q, k, v = (jnp.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3))
+    o = _ring.blockwise_attention(q, k, v, block_size=block or None,
+                                  causal=causal)
+    o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, e)
+    x = x + jnp.einsum("bse,fe->bsf", o, wout) + bout
+    f = _layer_norm(x, ln2_g, ln2_b)
+    f = jax.nn.relu(jnp.einsum("bse,he->bsh", f, w1) + b1)
+    f = jnp.einsum("bsh,eh->bse", f, w2) + b2
+    return x + f
+
+
+def _pipe_mesh():
+    """Ambient mesh carrying a 'pipe' axis, if any."""
+    from ..parallel import mesh as _mesh
+    m = _mesh.current_mesh()
+    if m is not None and _mesh.AXIS_PIPE in m.axis_names:
+        return m
+    return None
+
+
+def _transformer_stack(op_ctx, attrs, inputs, aux):
+    L, num_heads, H, causal, block, micro = _stack_attrs(attrs)
+    x, params = inputs[0], tuple(inputs[1:])
+
+    def run_layers(stack, xin):
+        def body(carry, p):
+            return _one_layer(p, carry, num_heads, causal, block), None
+        out, _ = jax.lax.scan(body, xin, stack)
+        return out
+
+    mesh = _pipe_mesh()
+    if mesh is None:
+        return (run_layers(params, x),)
+
+    from ..parallel.mesh import (AXIS_DATA, AXIS_PIPE, check_axis_divides,
+                                 data_axis_size)
+    from ..parallel.pipeline import pipeline_apply
+    S = data_axis_size(mesh, AXIS_PIPE)
+    check_axis_divides(mesh, AXIS_PIPE, L, "TransformerStack: num_layers")
+    check_axis_divides(mesh, AXIS_DATA, x.shape[0],
+                       "TransformerStack: batch dim")
+    # fold the (L, ...) stacks onto stages: (S, L/S, ...) — one stage per
+    # 'pipe' device, L/S layers scanned inside each stage body
+    staged = tuple(p.reshape((S, L // S) + p.shape[1:]) for p in params)
+    bax = AXIS_DATA if AXIS_DATA in mesh.axis_names else None
+    out = pipeline_apply(run_layers, staged, x, mesh, axis_name=AXIS_PIPE,
+                         num_microbatches=micro or None, batch_axis=bax)
+    return (out,)
+
+
+register_def(OpDef("TransformerStack", _transformer_stack,
+                   inputs=STACK_INPUTS, infer_shape=_stack_infer))

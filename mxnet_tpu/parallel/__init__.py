@@ -10,7 +10,8 @@ attention / sequence parallel) lives in mxnet_tpu.parallel.ring.
 """
 from .mesh import (make_mesh, data_parallel_mesh, current_mesh, MeshScope,
                    replicate, shard_batch, grad_sync, data_axis_size,
-                   superbatch_sharding)
+                   superbatch_sharding, parse_mesh_axes, mesh_from_spec,
+                   check_axis_divides)
 from . import ring  # noqa: F401
 from . import placement  # noqa: F401
 from .pipeline import pipeline_apply, pipeline_spmd  # noqa: F401

@@ -26,6 +26,10 @@ AXIS_PIPE = "pipe"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
 
+#: every axis name a multi-axis training mesh may carry, in canonical
+#: order (the order ``parse_mesh_axes`` normalizes specs into)
+AXIS_NAMES = (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT)
+
 
 def make_mesh(axis_shapes, devices=None):
     """Create a Mesh from {'data': 4, 'model': 2, ...}.
@@ -70,6 +74,83 @@ def model_parallel_mesh(num=None, devices=None):
                 "device_count)" % (num, len(devices)))
         devices = devices[:num]
     return make_mesh({AXIS_MODEL: len(devices)}, devices)
+
+
+def parse_mesh_axes(spec):
+    """Parse a mesh-axes spec — ``"data=2,seq=4"`` or a ``{"data": 2,
+    "seq": 4}`` dict — into an ordered ``{axis: size}`` dict (insertion
+    order preserved; that order becomes the mesh axis order). Axis names
+    must come from :data:`AXIS_NAMES`; sizes must be positive integers.
+    Raises :class:`MXNetError` naming the offending token."""
+    if isinstance(spec, dict):
+        items = list(spec.items())
+    else:
+        items = []
+        for tok in str(spec).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if "=" not in tok:
+                raise MXNetError(
+                    "mesh axes spec %r: token %r is not 'axis=N' "
+                    "(e.g. 'data=2,seq=4')" % (spec, tok))
+            name, _, num = tok.partition("=")
+            items.append((name.strip(), num.strip()))
+    axes = {}
+    for name, num in items:
+        if name not in AXIS_NAMES:
+            raise MXNetError(
+                "mesh axes spec %r: unknown axis %r (valid: %s)"
+                % (spec, name, ", ".join(AXIS_NAMES)))
+        try:
+            n = int(num)
+        except (TypeError, ValueError):
+            raise MXNetError("mesh axes spec %r: axis %r size %r is not "
+                             "an integer" % (spec, name, num))
+        if n < 1:
+            raise MXNetError("mesh axes spec %r: axis %r size must be "
+                             ">= 1, got %d" % (spec, name, n))
+        if name in axes:
+            raise MXNetError("mesh axes spec %r: axis %r given twice"
+                             % (spec, name))
+        axes[name] = n
+    if not axes:
+        raise MXNetError("mesh axes spec %r names no axes" % (spec,))
+    return axes
+
+
+def mesh_from_spec(spec, devices=None):
+    """Build a multi-axis Mesh from a spec (:func:`parse_mesh_axes`
+    accepts strings and dicts) over the first ``prod(sizes)`` visible
+    devices. A device shortfall fails actionably with the
+    ``XLA_FLAGS`` recipe instead of :func:`make_mesh`'s bare count."""
+    axes = parse_mesh_axes(spec)
+    if devices is None:
+        devices = jax.devices()
+    need = int(np.prod(list(axes.values())))
+    if need > len(devices):
+        raise MXNetError(
+            "mesh %s needs %d devices but only %d are visible — on CPU "
+            "raise the count with XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=%d"
+            % ("x".join("%s=%d" % kv for kv in axes.items()), need,
+               len(devices), need))
+    return make_mesh(axes, list(devices)[:need])
+
+
+def check_axis_divides(mesh, axis, value, what):
+    """Divisibility precheck for one mesh axis: ``value`` (the dimension
+    the axis will shard) must divide evenly over the axis. Raises
+    :class:`MXNetError` NAMING the failing axis and the offending
+    dimension — the error a user can act on, instead of the XLA
+    partitioner's shape complaint three layers down. No-op when the mesh
+    lacks the axis (size 1 divides everything)."""
+    n = data_axis_size(mesh, axis)
+    if n > 1 and int(value) % n:
+        raise MXNetError(
+            "%s %d does not divide the %d-way %r mesh axis — every shard "
+            "must be equal (pad %s or pick a size divisible by %d)"
+            % (what, int(value), n, axis, what, n))
 
 
 class MeshScope(object):
@@ -140,14 +221,25 @@ def data_axis_size(mesh, axis=AXIS_DATA):
     return int(mesh.shape[axis])
 
 
-def superbatch_sharding(mesh, axis=AXIS_DATA):
+def superbatch_sharding(mesh, axis=AXIS_DATA, seq=False):
     """NamedSharding for stacked (k, batch, ...) superbatch arrays: the
     step axis replicated, the batch axis sharded along ``axis``. This is
     the sharding ``SuperBatchIter`` lands its H2D with, so each chip
     receives only its own batch shard and the dispatch loop never pays a
     resharding copy (the dist_sync data partition, one level up: the unit
-    is a whole K-step dispatch)."""
-    if mesh is None or axis not in mesh.axis_names:
+    is a whole K-step dispatch).
+
+    ``seq=True`` additionally splits dim 2 (the token dim of a stacked
+    (k, batch, seq) LM batch) over the 'seq' axis when the mesh carries
+    one — the multi-axis variant; only valid when EVERY array the
+    sharding will land is rank >= 3 stacked (SuperBatchIter applies one
+    sharding to all slots)."""
+    if mesh is None:
+        return None
+    if seq and AXIS_SEQ in mesh.axis_names:
+        bax = axis if axis in mesh.axis_names else None
+        return jax.sharding.NamedSharding(mesh, P(None, bax, AXIS_SEQ))
+    if axis not in mesh.axis_names:
         return None
     return jax.sharding.NamedSharding(mesh, P(None, axis))
 

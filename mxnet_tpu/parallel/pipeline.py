@@ -70,28 +70,55 @@ def pipeline_spmd(stage_fn, stacked_params, microbatches, axis_name="pipe"):
 
 
 def pipeline_apply(stage_fn, stacked_params, batch, mesh, axis_name="pipe",
-                   num_microbatches=None):
+                   num_microbatches=None, batch_axis=None):
     """jit-able wrapper: shard stacked params over ``axis_name``, split the
     batch into microbatches, run the GPipe schedule, and re-assemble.
 
     stacked_params leaves have leading dim S == mesh.shape[axis_name];
     batch is (B, ...) with B divisible by num_microbatches (default S).
+
+    ``batch_axis`` composes pipeline with data parallelism on one mesh:
+    when set (normally 'data'), each microbatch's batch dimension stays
+    sharded over that axis inside the schedule — the pipe ring hops and
+    the final psum ride ``axis_name`` only, so a data x pipe mesh runs
+    dp shards of the same pipeline side by side.
     """
+    from ..base import MXNetError
     S = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
     M = num_microbatches or S
     B = batch.shape[0]
     if B % M:
-        raise ValueError("batch %d not divisible into %d microbatches"
-                         % (B, M))
+        raise MXNetError(
+            "pipeline_apply: batch dim %d does not divide into %d "
+            "microbatches over the %d-way %r mesh axis — pad the batch "
+            "or pass a num_microbatches that divides it" % (B, M, S,
+                                                            axis_name))
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise MXNetError(
+                "pipeline_apply: stacked-parameter stage dim %d does not "
+                "match the %d-way %r mesh axis — stack one stage per "
+                "device (or reshape a layer stack to (stages, "
+                "layers_per_stage, ...) before the call)"
+                % (leaf.shape[0], S, axis_name))
     micro = batch.reshape((M, B // M) + batch.shape[1:])
+    if batch_axis is not None:
+        from .mesh import data_axis_size
+        dp = data_axis_size(mesh, batch_axis)
+        if (B // M) % dp:
+            raise MXNetError(
+                "pipeline_apply: microbatch dim %d does not divide the "
+                "%d-way %r mesh axis — every shard must be equal"
+                % (B // M, dp, batch_axis))
+    bspec = P() if batch_axis is None else P(None, batch_axis)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     from .mesh import shard_map_compat
     fn = shard_map_compat(
         functools.partial(pipeline_spmd, stage_fn, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
+        in_specs=(pspec, bspec),
+        out_specs=bspec,
         check_vma=False)
     out = fn(stacked_params, micro)
     return out.reshape((B,) + out.shape[2:])

@@ -314,6 +314,57 @@ class DecodeLoop(object):
         return cache_s, params_s, tok_s, pos_s
 
     # ------------------------------------------------------------------
+    def update_params(self, params):
+        """Hot-reload the LM parameter set under the RUNNING loop with
+        zero recompiles (train-to-serve handoff, docs/serving.md "Hot
+        reload"): the decode body takes params per call and only the KV
+        cache is donated, so swapping the dict re-binds the next step's
+        arguments without touching the compiled executable.
+
+        Every resident parameter must arrive with its exact shape; new
+        arrays land with the resident arrays' shardings (the AOT
+        executable binds placements). The swap is one atomic dict rebind —
+        the decode thread picks the new set up at its next step, and each
+        step reads the dict exactly once, so in-flight sequences continue
+        on a CONSISTENT parameter set (their KV cache keeps prefix
+        entries from the old weights — the standard continuous-batching
+        reload semantics; retire slots first for a clean cut)."""
+        import jax
+        import jax.numpy as jnp
+        missing = sorted(set(self._params) - set(params))
+        if missing:
+            raise MXNetError(
+                "update_params: checkpoint is missing %s — a partial swap "
+                "would decode a chimera; pass the full "
+                "models/transformer.py parameter set"
+                % ", ".join(missing[:8]))
+        new = {}
+        for n, resident in self._params.items():
+            arr = jnp.asarray(np.asarray(getattr(params[n], "data",
+                                                 params[n]), np.float32))
+            if tuple(arr.shape) != tuple(resident.shape):
+                raise MXNetError(
+                    "update_params: %r shape %s does not match the "
+                    "compiled decode body's %s — rebuild the loop for a "
+                    "different architecture"
+                    % (n, tuple(arr.shape), tuple(resident.shape)))
+            sh = getattr(resident, "sharding", None)
+            new[n] = jax.device_put(arr, sh) if sh is not None else arr
+        # land transfers BEFORE the rebind so the decode thread never
+        # blocks on (or races) an in-flight H2D mid-step
+        for v in new.values():
+            v.block_until_ready()
+        self._params = new
+        from ..obs import REGISTRY
+        REGISTRY.counter(
+            "serving.param_reloads",
+            "parameter hot-reloads into live serving engines").inc()
+        _obs.instant("decode_param_reload", params=len(new))
+        import logging
+        logging.info("%s: hot-reloaded %d parameters (zero recompiles)",
+                     self.name, len(new))
+
+    # ------------------------------------------------------------------
     def generate(self, prompt, max_new_tokens):
         """Queue one sequence; returns a :class:`GenerateFuture` whose
         ``result()`` is the list of generated token ids."""

@@ -409,6 +409,87 @@ class ServingEngine(object):
                          % (n, list(self.buckets)))
 
     # ------------------------------------------------------------------
+    def update_params(self, arg_params, aux_params=None):
+        """Hot-reload parameters under the LIVE engine with zero
+        recompiles — the train-to-serve handoff (docs/serving.md "Hot
+        reload"): a mid-training checkpoint swaps into a serving replica
+        without recompiling, re-bucketing, or dropping a request.
+
+        ``arg_params`` is a ``{name: array/NDArray}`` dict or a param-file
+        path (``load_param_dict`` formats — ``Module.save_checkpoint`` /
+        ``AsyncCheckpointWriter`` output load directly). Every non-input
+        argument of the serving graph must be present with the graph's
+        exact shape and the resident array's dtype; extra keys (stripped
+        loss heads, optimizer state) are ignored. New arrays are placed
+        with the RESIDENT arrays' shardings, so the AOT bucket executables
+        (which bind placements at compile time) keep serving — the swap is
+        one atomic dict rebind, safe against concurrent ``infer``."""
+        import jax
+        import jax.numpy as jnp
+        if isinstance(arg_params, (str, bytes)) or hasattr(arg_params,
+                                                           "read"):
+            arg_params, file_aux = load_param_dict(arg_params)
+            if aux_params is None:
+                aux_params = file_aux
+        elif isinstance(arg_params, tuple) and len(arg_params) == 2:
+            arg_params, aux_params = arg_params
+
+        def validated(new, cur, kind):
+            missing = sorted(set(cur) - set(new))
+            if missing:
+                raise MXNetError(
+                    "update_params: checkpoint is missing %s %s — a "
+                    "partial swap would serve a chimera; pass every "
+                    "parameter of the serving graph"
+                    % (kind, ", ".join(missing)))
+            out = {}
+            for n, resident in cur.items():
+                arr = jnp.asarray(np.asarray(getattr(new[n], "data",
+                                                     new[n])))
+                if tuple(arr.shape) != tuple(resident.shape):
+                    raise MXNetError(
+                        "update_params: %s %r shape %s does not match the "
+                        "compiled graph's %s — the AOT executables bind "
+                        "shapes; rebuild the engine for a different "
+                        "architecture" % (kind, n, tuple(arr.shape),
+                                          tuple(resident.shape)))
+                if arr.dtype != resident.dtype:
+                    if not np.issubdtype(arr.dtype, np.floating):
+                        raise MXNetError(
+                            "update_params: %s %r dtype %s does not match "
+                            "the resident %s" % (kind, n, arr.dtype,
+                                                 resident.dtype))
+                    # f32 checkpoints of a bf16-serving engine (and vice
+                    # versa) widen/narrow to the compiled dtype — the
+                    # executable's input layout is fixed
+                    arr = arr.astype(resident.dtype)
+                sh = getattr(resident, "sharding", None)
+                out[n] = (jax.device_put(arr, sh) if sh is not None
+                          else arr)
+            return out
+
+        if self._aux and aux_params is None:
+            raise MXNetError(
+                "update_params: the graph has aux states %s but no "
+                "aux_params were passed" % sorted(self._aux))
+        new_params = validated(arg_params, self._params, "parameter")
+        new_aux = (validated(aux_params, self._aux, "aux state")
+                   if self._aux else dict(self._aux))
+        # land the transfers BEFORE the rebind: a request dispatched the
+        # instant after the swap must never block on (or race) an H2D
+        for v in list(new_params.values()) + list(new_aux.values()):
+            v.block_until_ready()
+        # atomic rebind (CPython assignment): concurrent infer() sees the
+        # old set or the new set, never a mix
+        self._params, self._aux = new_params, new_aux
+        from ..obs import REGISTRY
+        REGISTRY.counter(
+            "serving.param_reloads",
+            "parameter hot-reloads into live serving engines").inc()
+        logging.info("%s: hot-reloaded %d parameters (zero recompiles)",
+                     self.name, len(new_params))
+
+    # ------------------------------------------------------------------
     def infer(self, inputs):
         """Run the compiled forward over ``{name: (n, ...) array}``; returns
         a list of np arrays with pad rows already sliced off. Requests

@@ -312,6 +312,35 @@ class FleetRouter(object):
             rep.state = RETIRED
         return rep.report()
 
+    def update_params(self, arg_params, aux_params=None):
+        """Hot-reload parameters into EVERY in-rotation replica's engine
+        with zero recompiles (:meth:`ServingEngine.update_params` fanned
+        out) — the fleet half of the train-to-serve handoff. Replicas
+        sharing one engine (a warm rejoin) reload once; retired/dead
+        replicas are skipped. Each engine's swap is atomic, so a request
+        in flight during the rollout serves from either the old or the
+        new set, never a mix — the fleet is briefly mixed-version, which
+        is the standard rolling-update semantics. Returns the engine
+        names reloaded."""
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError("fleet router is closed")
+            engines, seen = [], set()
+            for r in self._replicas.values():
+                if r.state in (DEAD, RETIRED):
+                    continue
+                eng = r.batcher.engine
+                if id(eng) not in seen:
+                    seen.add(id(eng))
+                    engines.append(eng)
+        if not engines:
+            raise MXNetError("FleetRouter.update_params: no live replicas "
+                             "to reload")
+        for eng in engines:
+            eng.update_params(arg_params, aux_params)
+        _obs.instant("fleet_param_reload", engines=len(engines))
+        return [eng.name for eng in engines]
+
     # ------------------------------------------------------------------
     # request path
     # ------------------------------------------------------------------

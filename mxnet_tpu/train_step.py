@@ -368,6 +368,21 @@ class TrainStep(object):
         self.health = None  # per-run TrainingHealth (Module attaches it)
 
     # ------------------------------------------------------------------
+    def _ambient(self):
+        """Ambient-mesh scope for jit trace/dispatch. Ops that dispatch on
+        ``parallel.mesh.current_mesh()`` (MultiHeadAttention's 'seq' modes,
+        TransformerStack's 'pipe' schedule) must see THIS TrainStep's mesh
+        while the program traces; entering the scope on every dispatch
+        keeps the first (tracing) call and steady-state calls identical,
+        so the multi-axis program never depends on the caller remembering
+        a ``with MeshScope(...)`` around ``fit``."""
+        if self.mesh is None:
+            import contextlib
+            return contextlib.nullcontext()
+        from .parallel.mesh import MeshScope
+        return MeshScope(self.mesh)
+
+    # ------------------------------------------------------------------
     def _wrap_remat(self, run):
         """Memory mirroring: recompute activations in backward
         (ref: MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:213-226).
@@ -534,6 +549,16 @@ class TrainStep(object):
                         "shard_batch: %r batch dim %d does not divide the "
                         "%d-way 'data' mesh axis — pad the batch or pick a "
                         "divisible batch size" % (k, b, n))
+        if has_seq:
+            sp = data_axis_size(self.mesh, AXIS_SEQ)
+            for k, v in batch.items():
+                shp = (v.shape if hasattr(v, "shape")
+                       else np.asarray(v).shape)
+                if len(shp) >= 2 and shp[1] % sp:
+                    raise MXNetError(
+                        "shard_batch: %r sequence dim %d does not divide "
+                        "the %d-way 'seq' mesh axis — pad the sequence or "
+                        "pick a divisible seq_len" % (k, shp[1], sp))
 
         def spec_for(v):
             nd = getattr(v, "ndim", None)
@@ -677,16 +702,74 @@ class TrainStep(object):
             step_inc = ok.astype(jnp.int32) if guard else 1
             new_state = {"params": new_params, "aux": new_aux,
                          "opt": new_opt, "step": state["step"] + step_inc}
+            new_state = self._pin_state_sharding(new_state)
             if guard:
                 return new_state, outs, (ok, gnorm)
             return new_state, outs
 
         return step_fn
 
-    def _build(self, batch_size):
-        return jax.jit(self._make_step_fn(batch_size), donate_argnums=(0,))
+    def _pin_state_sharding(self, state):
+        """Constrain the OUTPUT state to the same shardings ``_shard_state``
+        placed the input with. Without the pin, GSPMD is free to return the
+        state under whatever sharding its solver picked for a multi-axis
+        mesh — then dispatch 2's argument shardings differ from dispatch
+        1's and the jit cache misses once (a retrace tracecheck rightly
+        flags). Pinning closes the loop: state out == state in, every
+        dispatch hits the first compile."""
+        if self.mesh is None:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def _build_guard_step(self, batch_size):
+        def con(v, spec):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(self.mesh, spec))
+
+        out = dict(state)
+        out["params"] = {n: con(v, self._param_spec(n, v.shape))
+                         for n, v in state["params"].items()}
+        out["opt"] = {
+            n: jax.tree_util.tree_map(
+                lambda v, _n=n: con(v, self._param_spec(_n, v.shape)), st)
+            for n, st in state["opt"].items()}
+        out["aux"] = {n: con(v, P()) for n, v in state["aux"].items()}
+        out["step"] = con(state["step"], P())
+        return out
+
+    def _state_out_shardings(self, state):
+        """Prefix pytree of jit ``out_shardings`` for the state: params and
+        optimizer state pinned to their placement spec (one spec per param
+        covers its whole opt-state subtree), aux/step replicated — exactly
+        what ``_shard_state`` placed the inputs with. The in-body
+        ``_pin_state_sharding`` constraint alone does not survive the
+        scan-carry unification on every backend (jax 0.4.x may hand back
+        solver-chosen shardings from the While root), and an unpinned
+        output misses the jit cache on the next dispatch. ``None`` when no
+        mesh (and for the non-state outputs: propagation decides)."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+
+        def ns(spec):
+            return NamedSharding(self.mesh, spec)
+
+        return {
+            "params": {n: ns(self._param_spec(n, v.shape))
+                       for n, v in state["params"].items()},
+            "opt": {n: ns(self._param_spec(n, state["params"][n].shape))
+                    for n in state["opt"]},
+            "aux": {n: ns(P()) for n in state["aux"]},
+            "step": ns(P()),
+        }
+
+    def _build(self, batch_size, state=None):
+        outs = None
+        if state is not None and self.mesh is not None:
+            outs = (self._state_out_shardings(state), None)
+        return jax.jit(self._make_step_fn(batch_size), donate_argnums=(0,),
+                       out_shardings=outs)
+
+    def _build_guard_step(self, batch_size, state=None):
         """Guarded single-step jit: the fused body plus device sentinels,
         returning ``(new_state, outs, packed)`` where ``packed`` is the same
         ``[loss, correct, nsamp, skipped, grad_norm]`` layout the guarded
@@ -708,9 +791,13 @@ class TrainStep(object):
                 gnorm.astype(jnp.float32)])
             return new_st, outs, packed
 
-        return jax.jit(fn, donate_argnums=(0,))
+        outs_sh = None
+        if state is not None and self.mesh is not None:
+            outs_sh = (self._state_out_shardings(state), None, None)
+        return jax.jit(fn, donate_argnums=(0,), out_shardings=outs_sh)
 
-    def _build_scan(self, batch_size, k, guard=False, metric_spec=None):
+    def _build_scan(self, batch_size, k, guard=False, metric_spec=None,
+                    state=None):
         """K steps in ONE compiled dispatch: lax.scan of the fused step body
         over a stacked (k, batch, ...) superbatch, state donated across the
         whole scan. This is the reference engine's bulking — whole graph
@@ -789,7 +876,10 @@ class TrainStep(object):
             # one packed array => one host transfer for all K-step metrics
             return state, jnp.stack(list(slots))
 
-        return jax.jit(scan_fn, donate_argnums=(0,))
+        outs_sh = None
+        if state is not None and self.mesh is not None:
+            outs_sh = (self._state_out_shardings(state), None)
+        return jax.jit(scan_fn, donate_argnums=(0,), out_shardings=outs_sh)
 
     def _dispatch_key(self):
         if self._needs_rng or getattr(self._opt, "fused_needs_key", False):
@@ -905,7 +995,7 @@ class TrainStep(object):
         bs = next(iter(batch.values())).shape[0]
         if guard:
             if bs not in self._jit_g:
-                self._jit_g[bs] = self._build_guard_step(bs)
+                self._jit_g[bs] = self._build_guard_step(bs, state=state)
             fn = self._jit_g[bs]
             # 0-d np.asarray pins (see run_steps): explicit dtype + explicit
             # device transfer for the per-step lr/poison scalars (a bare
@@ -915,16 +1005,18 @@ class TrainStep(object):
                                                 np.float32)),
                          jnp.asarray(np.asarray(
                              self._poison_scalars(1)[0], np.float32)))
-            out = fn(*call_args)
-            self._tc_after("guard-step", bs, fn, call_args, result=out)
+            with self._ambient():
+                out = fn(*call_args)
+                self._tc_after("guard-step", bs, fn, call_args, result=out)
             return out
         if bs not in self._jit:
-            self._jit[bs] = self._build(bs)
+            self._jit[bs] = self._build(bs, state=state)
         fn = self._jit[bs]
         call_args = (state, batch, self._dispatch_key(),
                      jnp.asarray(np.asarray(self._next_lr(), np.float32)))
-        out = fn(*call_args)
-        self._tc_after("step", bs, fn, call_args, result=out)
+        with self._ambient():
+            out = fn(*call_args)
+            self._tc_after("step", bs, fn, call_args, result=out)
         return out
 
     def run_steps(self, state, superbatch, k=None, guard=False,
@@ -977,7 +1069,8 @@ class TrainStep(object):
                 else (bs, k, metric_spec.signature))
         if ckey not in cache:
             cache[ckey] = self._build_scan(bs, k, guard=guard,
-                                           metric_spec=metric_spec)
+                                           metric_spec=metric_spec,
+                                           state=state)
         fn = cache[ckey]
         # lr vector pinned through np.float32 BEFORE the device transfer:
         # the explicit f32 pin keeps the trace weak-type-free under any
@@ -990,16 +1083,18 @@ class TrainStep(object):
         if guard:
             call_args = (state, superbatch, self._dispatch_key(), lrs,
                          jnp.asarray(self._poison_scalars(k)))
-            new_state, packed = fn(*call_args)
-            sums = StepMetrics(packed, guarded=True, spec=metric_spec)
-            self._tc_after("guard-scan", ckey, fn, call_args,
-                           result=(new_state, sums), spec=metric_spec)
+            with self._ambient():
+                new_state, packed = fn(*call_args)
+                sums = StepMetrics(packed, guarded=True, spec=metric_spec)
+                self._tc_after("guard-scan", ckey, fn, call_args,
+                               result=(new_state, sums), spec=metric_spec)
             return new_state, sums
         call_args = (state, superbatch, self._dispatch_key(), lrs)
-        new_state, packed = fn(*call_args)
-        sums = StepMetrics(packed, spec=metric_spec)
-        self._tc_after("scan", ckey, fn, call_args,
-                       result=(new_state, sums), spec=metric_spec)
+        with self._ambient():
+            new_state, packed = fn(*call_args)
+            sums = StepMetrics(packed, spec=metric_spec)
+            self._tc_after("scan", ckey, fn, call_args,
+                           result=(new_state, sums), spec=metric_spec)
         return new_state, sums
 
     def shard_superbatch(self, superbatch):
@@ -1030,6 +1125,16 @@ class TrainStep(object):
                     raise MXNetError(
                         "shard_superbatch: %r batch dim %d does not divide "
                         "the %d-way 'data' mesh axis" % (name, b, n))
+        if has_seq:
+            sp = data_axis_size(self.mesh, AXIS_SEQ)
+            for name, v in superbatch.items():
+                shp = getattr(v, "shape", ())
+                if len(shp) >= 3 and shp[2] % sp:
+                    raise MXNetError(
+                        "shard_superbatch: %r sequence dim %d does not "
+                        "divide the %d-way 'seq' mesh axis — pad the "
+                        "sequence or pick a divisible seq_len"
+                        % (name, shp[2], sp))
 
         def spec_for(v):
             if has_seq and v.ndim >= 3:

@@ -1,0 +1,426 @@
+"""Flagship LM: multi-axis mesh training, divisibility prechecks,
+zero-recompile train-to-serve hot reload, Speedometer tokens/sec and
+tuning-DB resolution (docs/perf.md "Flagship LM").
+
+Runs on the virtual 8-device CPU mesh (conftest).
+"""
+import logging
+import os
+import tempfile
+import types
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.mesh import (make_mesh, mesh_from_spec,
+                                     parse_mesh_axes, MeshScope)
+from mxnet_tpu.test_utils import assert_no_retrace
+
+V, E, H, L, S, B = 32, 32, 4, 2, 16, 8
+
+
+def _lm_symbol(**kw):
+    kw.setdefault("vocab_size", V)
+    kw.setdefault("embed", E)
+    kw.setdefault("num_heads", H)
+    kw.setdefault("num_layers", L)
+    kw.setdefault("seq_len", S)
+    return models.transformer(**kw)
+
+
+def _lm_iter(n=4 * B, batch=B, seed=0):
+    rng = np.random.RandomState(seed)
+    return mx.io.NDArrayIter(
+        data={"data": rng.randint(0, V, (n, S)).astype(np.float32)},
+        label={"softmax_label": rng.randint(0, V, (n, S))
+               .astype(np.float32)},
+        batch_size=batch)
+
+
+def _fit_lm(mesh_axes=None, seed=7, epochs=1, sym=None, **fit_kw):
+    mod = mx.mod.Module(sym if sym is not None else _lm_symbol(),
+                        context=mx.cpu(), mesh_axes=mesh_axes)
+    mx.random.seed(seed)
+    mod.fit(_lm_iter(), num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.initializer.Xavier(),
+            eval_metric=mx.metric.Perplexity(ignore_label=None), **fit_kw)
+    return mod
+
+
+def _snap(mod):
+    a, x = mod.get_params()
+    return ({k: v.asnumpy().copy() for k, v in a.items()},
+            {k: v.asnumpy().copy() for k, v in x.items()})
+
+
+# ---------------------------------------------------------------------------
+# mesh-spec parsing + divisibility prechecks (the actionable-error tentpole)
+# ---------------------------------------------------------------------------
+
+def test_parse_mesh_axes_rejects_junk():
+    with pytest.raises(MXNetError, match="bogus"):
+        parse_mesh_axes("bogus=2")
+    with pytest.raises(MXNetError):
+        parse_mesh_axes("data=0")
+    with pytest.raises(MXNetError):
+        parse_mesh_axes("data")
+    assert parse_mesh_axes("data=2,seq=4") == {"data": 2, "seq": 4}
+    assert parse_mesh_axes({"pipe": 2}) == {"pipe": 2}
+
+
+def test_mesh_from_spec_device_shortfall_names_recipe():
+    with pytest.raises(MXNetError, match="xla_force_host_platform"):
+        mesh_from_spec("data=64")
+
+
+def test_fit_batch_indivisible_names_data_axis():
+    # batch 8 over a 3-way 'data' axis: the Module-level precheck must
+    # fail actionably, naming the axis — not an XLA shape complaint
+    mod = mx.mod.Module(_lm_symbol(), context=mx.cpu(), mesh_axes="data=3")
+    with pytest.raises(MXNetError, match="data"):
+        mod.fit(_lm_iter(), num_epoch=1, optimizer="sgd",
+                initializer=mx.initializer.Xavier(),
+                eval_metric=mx.metric.Perplexity(ignore_label=None))
+
+
+def test_fit_seq_indivisible_names_seq_axis():
+    # seq_len 16 over a 3-way 'seq' axis (batch 9 divides data=1 fine)
+    mod = mx.mod.Module(_lm_symbol(), context=mx.cpu(), mesh_axes="seq=3")
+    with pytest.raises(MXNetError, match="seq"):
+        mod.fit(_lm_iter(n=18, batch=9), num_epoch=1, optimizer="sgd",
+                initializer=mx.initializer.Xavier(),
+                eval_metric=mx.metric.Perplexity(ignore_label=None))
+
+
+def test_composed_mesh_error_names_offending_axis():
+    # on the COMPOSED dp x sp mesh the batch divides 'data' but seq_len
+    # 16 does not divide the 8-way 'seq' axis... the error must name
+    # 'seq' and the dimension, not the first axis it checked
+    mod = mx.mod.Module(_lm_symbol(), context=mx.cpu(),
+                        mesh_axes="data=2,seq=8")
+    with pytest.raises(MXNetError) as ei:
+        mod.fit(_lm_iter(), num_epoch=1, optimizer="sgd",
+                initializer=mx.initializer.Xavier(),
+                eval_metric=mx.metric.Perplexity(ignore_label=None))
+    msg = str(ei.value)
+    assert "seq" in msg and "16" in msg
+
+
+def test_ring_attention_seq_divisibility_precheck():
+    from mxnet_tpu.train_step import TrainStep
+    sym = _lm_symbol(seq_parallel="ring")
+    mesh = make_mesh({"seq": 3}) if False else mesh_from_spec("seq=3")
+    step = TrainStep(sym, optimizer="sgd", learning_rate=0.1, mesh=mesh)
+    state = step.init({"data": (6, S)}, {"softmax_label": (6, S)})
+    batch = {"data": np.zeros((6, S), np.float32),
+             "softmax_label": np.zeros((6, S), np.float32)}
+    with pytest.raises(MXNetError, match="sequence dim"):
+        step.step(state, step.shard_batch(batch))
+
+
+def test_ulysses_heads_divisibility_precheck():
+    # seq divides the 8-way axis (16 % 8 == 0) but num_heads 4 does not:
+    # Ulysses' head all-to-all needs heads % sp == 0 and must say so
+    x = mx.nd.array(np.zeros((2, S, E), np.float32))
+    wqkv = mx.nd.array(np.zeros((3 * E, E), np.float32))
+    wout = mx.nd.array(np.zeros((E, E), np.float32))
+    with MeshScope(mesh_from_spec("seq=8")):
+        with pytest.raises(MXNetError, match="num_heads"):
+            mx.nd.MultiHeadAttention(x, wqkv, wout, num_heads=H,
+                                     no_bias=True, causal=True,
+                                     seq_parallel="ulysses")
+
+
+def test_pipe_stack_layer_divisibility_precheck():
+    from mxnet_tpu.train_step import TrainStep
+    sym = _lm_symbol(num_layers=3, stack_layers=True)
+    step = TrainStep(sym, optimizer="sgd", learning_rate=0.1,
+                     mesh=mesh_from_spec("pipe=2"))
+    state = step.init({"data": (B, S)}, {"softmax_label": (B, S)})
+    batch = {"data": np.zeros((B, S), np.float32),
+             "softmax_label": np.zeros((B, S), np.float32)}
+    with pytest.raises(MXNetError, match="num_layers"):
+        step.step(state, step.shard_batch(batch))
+
+
+def test_module_mesh_axes_rejects_dist_kvstore():
+    # multi-worker dist kvstore (num_workers > 1 is what makes it dist —
+    # unreachable in a single-process test, so fake it) + an explicit
+    # multi-axis mesh must refuse before building the fused step
+    mod = mx.mod.Module(_lm_symbol(), context=mx.cpu(),
+                        mesh_axes="data=2")
+    mod.bind(data_shapes=[("data", (B, S))],
+             label_shapes=[("softmax_label", (B, S))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    mod._kvstore = types.SimpleNamespace(type="dist_sync", num_workers=2)
+    with pytest.raises(MXNetError, match="dist"):
+        mod._build_fused()
+
+
+# ---------------------------------------------------------------------------
+# get_symbol build-time validation (the satellite's actionable errors)
+# ---------------------------------------------------------------------------
+
+def test_get_symbol_validation_errors():
+    with pytest.raises(MXNetError, match="vocab_size"):
+        _lm_symbol(vocab_size=1)
+    with pytest.raises(MXNetError, match="num_heads"):
+        _lm_symbol(embed=30)  # 30 % 4 != 0
+    with pytest.raises(MXNetError, match="max_seq_len"):
+        _lm_symbol(max_seq_len=S - 1)
+    with pytest.raises(MXNetError, match="block_size"):
+        _lm_symbol(block_size=S + 1)
+    with pytest.raises(MXNetError, match="block"):
+        _lm_symbol(block_size=3)  # 16 % 3 != 0
+    with pytest.raises(MXNetError, match="seq_parallel"):
+        _lm_symbol(stack_layers=True, seq_parallel="ring")
+    with pytest.raises(MXNetError, match="dropout"):
+        _lm_symbol(stack_layers=True, dropout=0.1)
+
+
+def test_get_symbol_max_seq_len_table_rows():
+    # the pos-embed table is decoupled from the training window
+    sym = _lm_symbol(max_seq_len=4 * S)
+    arg_shapes, _, _ = sym.infer_shape(data=(2, S), softmax_label=(2, S))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    assert shapes["pos_embed_weight"] == (4 * S, E)
+
+
+# ---------------------------------------------------------------------------
+# multi-axis fit parity (dp x sp through the fused scan)
+# ---------------------------------------------------------------------------
+
+def test_fit_multi_axis_dp_sp_parity_and_no_retrace():
+    ref = _fit_lm()
+    a_ref, _ = _snap(ref)
+    mod = _fit_lm(mesh_axes="data=2,seq=2", steps_per_dispatch=2)
+    a, _ = _snap(mod)
+    from mxnet_tpu import tracecheck
+    assert tracecheck.retrace_count() == 0, tracecheck.RETRACE_EVENTS
+    assert set(a) == set(a_ref)
+    for k in a_ref:
+        np.testing.assert_allclose(a[k], a_ref[k], rtol=2e-3, atol=2e-5,
+                                   err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# zero-recompile train-to-serve hot reload
+# ---------------------------------------------------------------------------
+
+def _random_lm_params(seed):
+    sym = _lm_symbol()
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=(2, S),
+                                                softmax_label=(2, S))
+    rng = np.random.RandomState(seed)
+    args = {}
+    for n, shp in zip(sym.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        args[n] = (rng.randn(*shp) * 0.05).astype(np.float32)
+    return sym, args
+
+
+def test_decode_loop_update_params_bitwise():
+    from mxnet_tpu.serving import DecodeLoop
+    _, args0 = _random_lm_params(0)
+    _, args1 = _random_lm_params(1)
+    prompt = [1, 2, 3]
+    loop = DecodeLoop(args0, num_layers=L, num_heads=H, max_len=S, slots=2)
+    try:
+        loop.generate(prompt, 4).result(timeout=60)
+        with assert_no_retrace(msg="decode hot reload"):
+            loop.update_params(args1)
+            new = loop.generate(prompt, 4).result(timeout=60)
+    finally:
+        loop.close()
+    fresh = DecodeLoop(args1, num_layers=L, num_heads=H, max_len=S,
+                       slots=2)
+    try:
+        ref = fresh.generate(prompt, 4).result(timeout=60)
+    finally:
+        fresh.close()
+    assert new == ref
+
+
+def test_decode_loop_update_params_missing_key():
+    from mxnet_tpu.serving import DecodeLoop
+    _, args0 = _random_lm_params(0)
+    loop = DecodeLoop(args0, num_layers=L, num_heads=H, max_len=S, slots=2)
+    try:
+        bad = dict(args0)
+        bad.pop("lm_head_weight")
+        with pytest.raises(MXNetError, match="lm_head_weight"):
+            loop.update_params(bad)
+    finally:
+        loop.close()
+
+
+def _engine_pair():
+    from mxnet_tpu.serving import ServingEngine
+    sym, args0 = _random_lm_params(0)
+    _, args1 = _random_lm_params(1)
+    sym_json = sym.tojson()
+    pd = {"arg:" + k: v for k, v in args0.items()}
+    eng = ServingEngine(sym_json, pd, {"data": (S,)}, buckets=(4,))
+    return eng, sym_json, args0, args1
+
+
+def test_engine_update_params_bitwise_and_zero_recompile():
+    from mxnet_tpu.serving import ServingEngine
+    eng, sym_json, args0, args1 = _engine_pair()
+    x = np.arange(4 * S, dtype=np.float32).reshape(4, S) % V
+    out_old = eng.infer({"data": x})[0]
+    with assert_no_retrace(msg="engine hot reload"):
+        eng.update_params(args1)
+        out_new = eng.infer({"data": x})[0]
+    eng2 = ServingEngine(
+        sym_json, {"arg:" + k: v for k, v in args1.items()},
+        {"data": (S,)}, buckets=(4,))
+    out_ref = eng2.infer({"data": x})[0]
+    assert np.array_equal(out_new, out_ref)
+    assert not np.array_equal(out_new, out_old)
+
+
+def test_engine_update_params_validation():
+    eng, _sym_json, args0, args1 = _engine_pair()
+    missing = dict(args1)
+    missing.pop("lm_head_weight")
+    with pytest.raises(MXNetError, match="missing"):
+        eng.update_params(missing)
+    bad_shape = dict(args1)
+    bad_shape["lm_head_weight"] = np.zeros((V, E + 1), np.float32)
+    with pytest.raises(MXNetError, match="lm_head_weight"):
+        eng.update_params(bad_shape)
+    # failed swaps must leave the resident set intact
+    x = np.zeros((4, S), np.float32)
+    eng.update_params(args0)
+    assert eng.infer({"data": x})[0] is not None
+
+
+def test_engine_update_params_from_checkpoint_file(tmp_path):
+    eng, sym_json, _args0, args1 = _engine_pair()
+    path = os.path.join(str(tmp_path), "lm-e0001-b00000000.params")
+    mx.nd.save(path, {"arg:" + k: mx.nd.array(v)
+                      for k, v in args1.items()})
+    x = np.zeros((4, S), np.float32)
+    with assert_no_retrace(msg="engine reload from checkpoint file"):
+        eng.update_params(path)
+        out = eng.infer({"data": x})[0]
+    from mxnet_tpu.serving import ServingEngine
+    eng2 = ServingEngine(
+        sym_json, {"arg:" + k: v for k, v in args1.items()},
+        {"data": (S,)}, buckets=(4,))
+    assert np.array_equal(out, eng2.infer({"data": x})[0])
+
+
+def test_fleet_update_params_fans_out_and_warm_join():
+    from mxnet_tpu.obs import REGISTRY
+    from mxnet_tpu.serving import FleetRouter, ServingEngine
+    eng, sym_json, _args0, args1 = _engine_pair()
+    counter = REGISTRY.counter("serving.param_reloads")
+    before = counter.value
+    router = FleetRouter({"r0": eng})
+    try:
+        reloaded = router.update_params(args1)
+        assert len(reloaded) == 1  # engine names, one shared engine
+        router.join("r1", lambda: ServingEngine(
+            sym_json, {"arg:" + k: v for k, v in args1.items()},
+            {"data": (S,)}, buckets=(4,)))
+        x = np.zeros((4, S), np.float32)
+        out = router.infer({"data": x})[0]
+    finally:
+        router.close()
+    eng2 = ServingEngine(
+        sym_json, {"arg:" + k: v for k, v in args1.items()},
+        {"data": (S,)}, buckets=(4,))
+    assert np.array_equal(out, eng2.infer({"data": x})[0])
+    assert counter.value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Speedometer tokens/sec (per-run, leak-proof)
+# ---------------------------------------------------------------------------
+
+def _speedo_param(nbatch, mod=None):
+    from mxnet_tpu.module.base_module import BatchEndParam
+    return BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                         locals={"self": mod} if mod is not None else None)
+
+
+class _FakeLMModule(object):
+    def _speed_tokens_per_sample(self):
+        return S
+
+    def _global_batch_scale(self):
+        return 1.0
+
+
+def test_speedometer_tokens_per_sec_suffix(caplog):
+    speedo = mx.callback.Speedometer(batch_size=B, frequent=2)
+    lm = _FakeLMModule()
+    with caplog.at_level(logging.INFO):
+        speedo(_speedo_param(0, lm))       # init
+        speedo(_speedo_param(2, lm))       # fires: LM run -> tokens/sec
+    lines = [r.getMessage() for r in caplog.records
+             if "samples/sec" in r.getMessage()]
+    assert lines and "tokens/sec" in lines[-1]
+
+
+def test_speedometer_tokens_suffix_does_not_leak_across_runs(caplog):
+    # ONE reused Speedometer: an LM run fires a tokens/sec line, then a
+    # foreign stream (no locals: score(), another run) fires — its line
+    # must NOT inherit the LM's tokens/sec suffix
+    speedo = mx.callback.Speedometer(batch_size=B, frequent=2)
+    lm = _FakeLMModule()
+    with caplog.at_level(logging.INFO):
+        speedo(_speedo_param(0, lm))
+        speedo(_speedo_param(2, lm))
+        speedo(_speedo_param(0))           # nbatch reset -> re-init
+        speedo(_speedo_param(2))
+    lines = [r.getMessage() for r in caplog.records
+             if "samples/sec" in r.getMessage()]
+    assert len(lines) == 2
+    assert "tokens/sec" in lines[0]
+    assert "tokens/sec" not in lines[1]
+
+
+def test_module_speed_tokens_per_sample_reads_label_shape():
+    mod = mx.mod.Module(_lm_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (B, S))],
+             label_shapes=[("softmax_label", (B, S))])
+    assert mod._speed_tokens_per_sample() == S
+
+
+# ---------------------------------------------------------------------------
+# tuning-DB resolution: a fresh no-args LM fit picks up tokens_per_sec knobs
+# ---------------------------------------------------------------------------
+
+def test_fit_resolves_tokens_per_sec_db_entry(tmp_path, monkeypatch):
+    from mxnet_tpu import autotune
+    from mxnet_tpu.autotune.db import TuningDB, symbol_signature
+    from mxnet_tpu.obs import REGISTRY
+    sym = _lm_symbol()
+    db_path = os.path.join(str(tmp_path), "tuned.json")
+    db = TuningDB(db_path)
+    db.put("transformer", "tokens_per_sec", B,
+           {"steps_per_dispatch": 2, "dispatch_pipeline": 1},
+           score=12345.0, unit="tokens/sec", kind="train",
+           symbol=sym.name, symbol_sig=symbol_signature(sym))
+    db.save()
+    monkeypatch.setenv("MXTPU_AUTOTUNE_DB", db_path)
+    counter = REGISTRY.counter("autotune.db_resolutions")
+    before = counter.value
+    # fresh NO-ARGS fit: no steps_per_dispatch arg, no env knob — the
+    # only source for k=2 is the DB entry; and the resolved config must
+    # hold zero unexpected retraces through the whole fit
+    with assert_no_retrace(msg="db-resolved LM fit"):
+        mod = _fit_lm()
+    assert counter.value == before + 1
+    assert any(k[1] == 2 for k in mod._fused._jit_scan), (
+        "fit did not run the DB-resolved K=2 fused scan; scan cache keys: "
+        "%r" % list(mod._fused._jit_scan))

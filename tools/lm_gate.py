@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""CI gate for the flagship LM (docs/perf.md "Flagship LM").
+
+A small transformer LM trained through ``Module.fit``'s fused K-step
+scan on the FORCED-HOST dp x sp mesh, asserting the whole
+train-to-serve story closes on 4 virtual CPU devices:
+
+1. dp2 x sp2 multi-axis fit matches the single-device fit's final
+   parameters (rtol 2e-3) — the composed mesh changes the schedule,
+   never the math;
+2. MID-FIT hot reload: an epoch-end callback swaps the live epoch-2
+   parameters into a :class:`DecodeLoop` that is already serving —
+   ZERO recompiles (``assert_no_retrace``) and the greedy decode is
+   BITWISE identical to a fresh engine built from the same snapshot;
+3. zero unexpected retraces across both fits (the multi-axis scan
+   carry is pinned by the jit-root ``out_shardings`` — a miss here is
+   a recompile storm in production);
+4. zero analyzer findings: the comms lints over the dp x sp scan
+   program, and ``memcheck.lint_resident_set`` over the CO-RESIDENT
+   train + serve program set (the fused scan plus every compiled
+   serving bucket — exactly what a train-then-serve host keeps live).
+
+Run via ci/lm.sh (sets the forced-host device count).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+V, E, H, L, S, B, K = 32, 32, 4, 2, 16, 8, 2
+EPOCHS = 3
+MESH = "data=2,seq=2"
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import commscheck, memcheck, models, tracecheck
+    from mxnet_tpu.serving import DecodeLoop, ServingEngine
+    from mxnet_tpu.test_utils import assert_no_retrace
+
+    if len(jax.devices()) < 4:
+        sys.exit("lm_gate: needs 4 devices for the %s mesh — run via "
+                 "ci/lm.sh (XLA_FLAGS=--xla_force_host_platform_"
+                 "device_count=8)" % MESH)
+
+    sym = models.transformer(vocab_size=V, embed=E, num_heads=H,
+                             num_layers=L, seq_len=S)
+    # the dp x sp fit runs the RING schedule (ppermute over 'seq') with
+    # the rank-3 preserve_shape head — the default symbol would leave
+    # the seq-sharded attention to GSPMD's generic resharding and merge
+    # sharded batch x seq dims at the head, whose in-loop all-gathers
+    # the comms lint rightly flags; parity of ring-vs-plain IS the
+    # tentpole's claim
+    sym_ring = models.transformer(vocab_size=V, embed=E, num_heads=H,
+                                  num_layers=L, seq_len=S,
+                                  seq_parallel="ring",
+                                  preserve_shape=True)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, V, (4 * B, S)).astype(np.float32)
+    label = rng.randint(0, V, (4 * B, S)).astype(np.float32)
+
+    def make_iter():
+        return mx.io.NDArrayIter(data={"data": data},
+                                 label={"softmax_label": label},
+                                 batch_size=B)
+
+    def run_fit(s=sym, mesh_axes=None, epoch_end=None, shardings=None,
+                **kw):
+        mod = mx.mod.Module(s, context=mx.cpu(), mesh_axes=mesh_axes,
+                            param_shardings=shardings)
+        mx.random.seed(7)
+        mod.fit(make_iter(), num_epoch=EPOCHS, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05},
+                initializer=mx.initializer.Xavier(),
+                eval_metric=mx.metric.Perplexity(ignore_label=None),
+                epoch_end_callback=epoch_end, **kw)
+        return mod
+
+    # -- 1) single-device reference
+    ref = run_fit()
+    a_ref, _ = ref.get_params()
+    a_ref = {k: v.asnumpy().copy() for k, v in a_ref.items()}
+
+    # -- 2) dp x sp fit with the mid-fit decode hot reload riding along
+    prompt = [1, 2, 3]
+    mid = {}
+
+    def epoch_end(epoch, _sym, arg, _aux):
+        snap = {k: v.asnumpy().copy() for k, v in arg.items()}
+        if epoch == 0:
+            # the serving loop goes live off the first epoch's params
+            mid["loop"] = DecodeLoop(snap, num_layers=L, num_heads=H,
+                                     max_len=S, slots=2)
+            mid["loop"].generate(prompt, 4).result(timeout=120)
+        elif epoch == 1:
+            # MID-FIT: swap epoch-2 params into the live loop — zero
+            # recompiles, decode must match a fresh engine bitwise
+            mid["params"] = snap
+            with assert_no_retrace(msg="mid-fit decode hot reload"):
+                mid["loop"].update_params(snap)
+                mid["tokens"] = mid["loop"].generate(
+                    prompt, 4).result(timeout=120)
+
+    # pos_embed rows belong to their 'seq' shard: the grad is naturally
+    # seq-sharded, so a replicated table would all-gather it every trip
+    # inside the optimizer (the comms lint catches exactly that)
+    mod = run_fit(s=sym_ring, mesh_axes=MESH, epoch_end=epoch_end,
+                  shardings={"pos_embed_weight": jax.sharding.PartitionSpec(
+                      "seq", None)},
+                  steps_per_dispatch=K)
+    a, _ = mod.get_params()
+    a = {k: v.asnumpy().copy() for k, v in a.items()}
+
+    # -- parity
+    if set(a) != set(a_ref):
+        sys.exit("lm_gate FAIL: param set drifted under %s: %r vs %r"
+                 % (MESH, sorted(a), sorted(a_ref)))
+    for k in sorted(a_ref):
+        if not np.allclose(a[k], a_ref[k], rtol=2e-3, atol=2e-5):
+            err = float(np.max(np.abs(a[k] - a_ref[k])))
+            sys.exit("lm_gate FAIL: %s mismatch vs single device on %s "
+                     "(max abs err %.3g) — the mesh changed the math"
+                     % (MESH, k, err))
+
+    # -- mid-fit hot reload: bitwise vs a fresh engine
+    if "tokens" not in mid:
+        sys.exit("lm_gate FAIL: the epoch-1 hot-reload callback never "
+                 "fired (epochs run: %d)" % EPOCHS)
+    fresh = DecodeLoop(mid["params"], num_layers=L, num_heads=H,
+                       max_len=S, slots=2)
+    want = fresh.generate(prompt, 4).result(timeout=120)
+    mid["loop"].close()
+    fresh.close()
+    if mid["tokens"] != want:
+        sys.exit("lm_gate FAIL: mid-fit hot-reloaded decode %r != fresh "
+                 "engine %r (must be bitwise)" % (mid["tokens"], want))
+
+    # -- zero unexpected retraces across both fits + the reload
+    if tracecheck.retrace_count():
+        sys.exit("lm_gate FAIL: %d unexpected retraces:\n%s"
+                 % (tracecheck.retrace_count(),
+                    "\n".join(map(str, tracecheck.RETRACE_EVENTS))))
+
+    # -- analyzers over the CO-RESIDENT train + serve program set
+    fused, state = mod._fused, mod._fused_state
+    sb = fused.shard_superbatch(
+        {"data": np.stack([data[:B]] * K),
+         "softmax_label": np.stack([label[:B]] * K)})
+    args = commscheck.struct_args(
+        (state, sb, fused._dispatch_key(), jnp.zeros((K,), jnp.float32)))
+    from mxnet_tpu.parallel.mesh import MeshScope
+    with MeshScope(fused.mesh):  # the ring op resolves 'seq' from it
+        compiled = fused._build_scan(B, K, state=state) \
+            .lower(*args).compile()
+    crep = commscheck.analyze_compiled(
+        compiled, "lm-gate/dp2xsp2/scan[k=%d]" % K, mesh=fused.mesh,
+        loop_trips=K)
+    findings = list(commscheck.lint_report(crep))
+    scan_mem = memcheck.analyze_compiled(
+        compiled, "lm-gate/dp2xsp2/scan[k=%d]" % K, args=args,
+        donate_argnums=(0,))
+    eng = ServingEngine(sym.tojson(),
+                        {"arg:" + k: v for k, v in a.items()},
+                        {"data": (S,)}, buckets=(4,))
+    eng.infer({"data": data[:4]})
+    resident = [scan_mem] + list(eng.memory_report().values())
+    findings += list(memcheck.lint_resident_set(
+        resident, "lm-gate train+serve"))
+    if findings:
+        sys.exit("lm_gate FAIL: %d analyzer findings over the train+serve "
+                 "set:\n%s" % (len(findings),
+                               "\n".join("  %s" % (f,) for f in findings)))
+
+    print("lm_gate: %s fit parity ok (%d params), mid-fit hot reload "
+          "bitwise ok (tokens %r), 0 retraces, 0 findings over %d "
+          "co-resident programs (scan + %d serving buckets)"
+          % (MESH, len(a), mid["tokens"], len(resident),
+             len(resident) - 1))
+
+
+if __name__ == "__main__":
+    main()
