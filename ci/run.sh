@@ -62,6 +62,13 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # (MXTPU_REALDATA_MIN_RATIO), zero tracecheck findings, and populated
 # DataHealth/PipelineStats
 ./ci/realdata.sh
+# elastic-distributed gate (docs/robustness.md "Elastic distributed
+# training"): REAL 3-process dist_sync run that SIGKILLs a worker
+# mid-epoch — emergency checkpoint, ring re-form at N-1 with re-derived
+# shards, accuracy floor, bitwise-consistent survivors, bitwise fresh
+# resume, and a collective-throughput floor vs 1 worker
+# (MXTPU_DIST_MIN_SCALE); emits DIST_r*.json
+./ci/dist.sh
 # multichip gate (docs/perf.md "Data-parallel scaling"): MEASURED — 8-device
 # fused-fit img/s + scaling efficiency vs 1 device (floor
 # MXTPU_MULTICHIP_MIN_EFF, default 0.7), guard + bitwise checkpoint/resume

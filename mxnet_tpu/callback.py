@@ -209,6 +209,23 @@ class Speedometer(object):
                 % (max(0.0, d["stall"]),
                    "%.1f" % q if q is not None else "n/a"))
 
+    def _dist_suffix(self, param):
+        """``Dist: workers=N stale=S`` whenever the run trains through a
+        dist kvstore (docs/robustness.md "Elastic distributed training"):
+        N is the CURRENT ring size — it shrinks in the log the moment a
+        re-form drops a dead worker — and S is the bounded-staleness lag
+        observed at the last pull (always 0 for dist_sync). Both are
+        instantaneous gauges read from THIS run's module via
+        ``param.locals``, so a reused Speedometer can never leak another
+        run's membership."""
+        loc = getattr(param, "locals", None)
+        mod = loc.get("self") if isinstance(loc, dict) else None
+        kv = getattr(mod, "_kvstore", None)
+        if kv is None or "dist" not in getattr(kv, "type", ""):
+            return ""
+        return ("\tDist: workers=%d stale=%d"
+                % (kv.num_workers, int(getattr(kv, "staleness_lag", 0))))
+
     def _retrace_suffix(self, init=False):
         """``Retraces: N`` once any watched jit entry has unexpectedly
         re-traced since this Speedometer started (docs/static_analysis.md):
@@ -249,6 +266,7 @@ class Speedometer(object):
                 health = self._health_suffix(param) \
                     + self._pipeline_suffix(param) \
                     + self._data_suffix(param) \
+                    + self._dist_suffix(param) \
                     + self._retrace_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()

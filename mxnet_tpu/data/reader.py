@@ -95,6 +95,7 @@ class ShardedRecordReader(object):
             self.idx = dict(probe.idx)  # key -> byte offset, shared
         finally:
             probe.close()
+        self._all_keys = all_keys   # full key list: reshard_workers re-cuts
         host_keys = _shard(all_keys, part_index, num_parts,
                            "%r num_parts" % path_imgrec)
         self.keys = _shard(host_keys, sub_index, sub_parts,
@@ -116,6 +117,20 @@ class ShardedRecordReader(object):
         if not self.shuffle:
             return list(self.keys)
         return epoch_permutation(self.seed, epoch, self.keys)
+
+    def reshard_workers(self, part_index, num_parts):
+        """Re-cut the host-level shard from the retained full key list —
+        the elastic-membership hook (docs/robustness.md "Elastic
+        distributed training"): after a ring re-form every survivor
+        re-derives its shard from its NEW (index, size) so the dead
+        worker's samples are redistributed instead of dropped. The
+        sub-shard level is re-applied unchanged. Readers stay open; only
+        the key set changes, taking effect at the next epoch_order()."""
+        host_keys = _shard(self._all_keys, part_index, num_parts,
+                           "%r num_parts" % self.uri)
+        self.keys = _shard(host_keys, self.sub_index, self.sub_parts,
+                           "%r sub_parts" % self.uri)
+        self.part_index, self.num_parts = part_index, num_parts
 
     # -- reading -------------------------------------------------------
     def _rec(self):

@@ -1,0 +1,615 @@
+"""Control-plane ring for elastic multi-process training.
+
+The reference's distributed tier rides ps-lite: a tracker rendezvouses
+workers and servers, Van/Postoffice move key-value messages, and
+GetDeadNodes watches heartbeats (ref: src/kvstore/kvstore_dist.h,
+ps-lite Van). Our rendezvous is ``jax.distributed`` — but XLA
+collectives are the WRONG substrate for elasticity: a peer that dies
+inside a psum leaves the survivors wedged in an uncancellable device
+wait. So cross-process reduction for `dist_sync` rides this module
+instead: a bulk-synchronous exchange over the coordination service's
+key-value store, where every wait loop aborts the moment a peer's
+heartbeat goes stale. Losing a worker surfaces as
+:class:`~mxnet_tpu.kvstore.WorkerLostError` in bounded time — never a
+hang — and the surviving members can re-form the ring at N-1 and keep
+training (docs/robustness.md "Elastic distributed training").
+
+Pieces:
+
+* :class:`LocalClient` — in-memory, thread-safe KV + liveness, the
+  tier-1 test double (threads stand in for processes).
+* :class:`CoordClient` — the same interface over jax's
+  DistributedRuntimeClient; liveness is heartbeat-stamp staleness.
+* :class:`Ring` — allreduce_sum / broadcast / barrier over the KV
+  plane, generation-tagged so a re-formed ring never reads a dead
+  generation's keys, plus the first-write-wins re-form protocol and
+  the epoch-boundary join protocol.
+
+Fault sites (docs/robustness.md "Fault injection"): ``kv.worker_die``
+fires at the top of every collective op ("die" SIGKILLs the process,
+the injector's raising kinds propagate), and ``kv.partition`` fires in
+the per-peer poll loop ("drop" models a dropped control-plane message:
+the read is requeued and retried, so a finite partition heals and a
+persistent one ends in KVStoreTimeoutError, never a hang).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from . import faults as _faults
+from .base import MXNetError
+
+__all__ = ["LocalClient", "CoordClient", "Ring", "DIST_HEALTH"]
+
+#: heartbeat key prefix shared with kvstore._Heartbeat
+HB_PREFIX = "mxtpu_hb/"
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class DistHealth(object):
+    """Process-global distributed-tier counters, mirrored into the obs
+    registry as the ``dist_health`` view (the ``TRAINING_HEALTH``
+    pattern, docs/observability.md)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.rank = -1
+        self.workers = 0
+        self.generation = 0
+        self.reforms = 0
+        self.worker_lost = 0
+        self.requeued = 0          # control-plane reads retried (partition)
+        self.heartbeats = 0        # beats published by this process
+        self.staleness_lag = 0     # dist_async: my_ver - min(peer_ver)
+        self.joins = 0
+        self.last_dead = ()
+
+    def report(self):
+        return {"rank": self.rank, "workers": self.workers,
+                "generation": self.generation, "reforms": self.reforms,
+                "worker_lost": self.worker_lost, "requeued": self.requeued,
+                "heartbeats": self.heartbeats,
+                "staleness_lag": self.staleness_lag, "joins": self.joins,
+                "last_dead": ",".join(str(r) for r in self.last_dead)}
+
+
+DIST_HEALTH = DistHealth()
+
+
+def _flight_dump(reason, extra=None):
+    try:
+        from .obs import flight
+        flight.dump(reason, extra=extra)
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------
+# KV-plane clients
+# --------------------------------------------------------------------------
+
+class LocalClient(object):
+    """In-memory control plane for tier-1 tests: threads play workers,
+    liveness is explicit (:meth:`mark_dead`), and there is no clock in
+    the loop — tests inject faults, not sleeps."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = {}
+        self._dead = set()
+
+    def set(self, key, value, overwrite=True):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            if not overwrite and key in self._store:
+                return False
+            self._store[key] = bytes(value)
+            return True
+
+    def get(self, key):
+        with self._lock:
+            return self._store.get(key)
+
+    def dir(self, prefix):
+        with self._lock:
+            return {k: v for k, v in self._store.items()
+                    if k.startswith(prefix)}
+
+    def delete(self, key):
+        with self._lock:
+            self._store.pop(key, None)
+
+    def alive(self, rank):
+        with self._lock:
+            return rank not in self._dead
+
+    def mark_dead(self, rank):
+        with self._lock:
+            self._dead.add(rank)
+
+    def revive(self, rank):
+        with self._lock:
+            self._dead.discard(rank)
+
+
+class CoordClient(object):
+    """The same interface over jax's coordination-service client.
+
+    Liveness: a rank is alive while its ``mxtpu_hb/<rank>`` stamp is
+    fresher than ``dead_for`` seconds (kvstore._Heartbeat publishes
+    every 2s). A rank with NO stamp is given ``grace`` seconds from
+    this client's creation — "not up yet" is not "dead"."""
+
+    def __init__(self, client, dead_for=None, grace=None):
+        self._c = client
+        self.dead_for = dead_for if dead_for is not None else \
+            _env_float("MXTPU_DIST_DEAD_FOR", 6.0)
+        self.grace = grace if grace is not None else \
+            _env_float("MXTPU_DIST_GRACE", 30.0)
+        self._started = time.time()
+
+    # -- kv --
+    def set(self, key, value, overwrite=True):
+        if isinstance(value, str):
+            value = value.encode()
+        try:
+            try:
+                self._c.key_value_set_bytes(key, bytes(value),
+                                            allow_overwrite=overwrite)
+            except TypeError:   # older binding: no allow_overwrite kwarg
+                if overwrite:
+                    try:
+                        self._c.key_value_delete(key)
+                    except Exception:
+                        pass
+                self._c.key_value_set_bytes(key, bytes(value))
+            return True
+        except Exception as e:
+            if not overwrite and "already exists" in str(e).lower():
+                return False
+            if not overwrite:
+                return False
+            raise
+
+    def get(self, key):
+        # no try_get on this binding, and dir-get treats its argument as
+        # a DIRECTORY (a probe without a trailing "/" gets one appended,
+        # so an exact-key probe always misses) — the non-blocking read is
+        # a parent-directory scan picking the exact key
+        parent = key.rsplit("/", 1)[0] + "/" if "/" in key else ""
+        return self._dir_raw(parent).get(key)
+
+    def dir(self, prefix):
+        return self._dir_raw(prefix)
+
+    def _dir_raw(self, prefix):
+        out = {}
+        try:
+            got = self._c.key_value_dir_get_bytes(prefix)
+        except Exception:
+            try:
+                got = self._c.key_value_dir_get(prefix)
+            except Exception:
+                return out
+        items = got.items() if hasattr(got, "items") else got
+        for k, v in items:
+            if isinstance(v, str):
+                v = v.encode()
+            out[k] = v
+        return out
+
+    def delete(self, key):
+        try:
+            self._c.key_value_delete(key)
+        except Exception:
+            pass
+
+    # -- liveness --
+    def alive(self, rank):
+        v = self.get(HB_PREFIX + "%d" % rank)
+        if v is None:
+            return time.time() - self._started <= self.grace
+        try:
+            stamp = float(v.decode())
+        except (ValueError, UnicodeDecodeError):
+            return True
+        return time.time() - stamp <= self.dead_for
+
+
+# --------------------------------------------------------------------------
+# array / payload codec
+# --------------------------------------------------------------------------
+
+def _encode_array(arr):
+    bio = io.BytesIO()
+    np.lib.format.write_array(bio, np.ascontiguousarray(arr),
+                              allow_pickle=False)
+    return bio.getvalue()
+
+
+def _decode_array(data):
+    return np.lib.format.read_array(io.BytesIO(data), allow_pickle=False)
+
+
+# --------------------------------------------------------------------------
+# the ring
+# --------------------------------------------------------------------------
+
+class Ring(object):
+    """Bulk-synchronous exchange group over a KV plane.
+
+    The BSP contract (every member runs the same collectives in the
+    same order — exactly what `dist_sync` training guarantees) makes a
+    monotonic sequence number a sufficient message tag. Keys live under
+    ``<ns>/g<gen>/...``: a re-formed ring bumps the generation, so
+    stragglers of the old membership can never read the new ring's
+    traffic. Determinism: reductions sum in member order, so every
+    worker computes a bitwise-identical result.
+    """
+
+    def __init__(self, client, rank, members, ns="mxring", poll=None,
+                 op_timeout=None):
+        self.client = client
+        self.rank = int(rank)
+        self.members = sorted(int(m) for m in members)
+        assert self.rank in self.members
+        self.ns = ns
+        self.gen = 0
+        self.seq = 0
+        self.poll = poll if poll is not None else \
+            _env_float("MXTPU_DIST_POLL", 0.005)
+        self.op_timeout = op_timeout if op_timeout is not None else \
+            _env_float("MXTPU_DIST_OP_TIMEOUT", 120.0)
+        self.dead = ()          # ranks found dead by the last failed op
+        self._published = []    # [(seq, [keys])] for trailing-edge GC
+        DIST_HEALTH.rank = self.rank
+        DIST_HEALTH.workers = len(self.members)
+
+    # -- membership helpers --
+    @property
+    def size(self):
+        return len(self.members)
+
+    @property
+    def index(self):
+        """This worker's logical position in the live membership (the
+        data-shard index after a re-form; the process rank is identity,
+        this is placement)."""
+        return self.members.index(self.rank)
+
+    def liveness_table(self):
+        return {str(r): ("self" if r == self.rank
+                         else ("alive" if self.client.alive(r) else "dead"))
+                for r in self.members}
+
+    # -- key layout --
+    def _key(self, kind, seq, rank):
+        return "%s/g%d/%s/%d/%d" % (self.ns, self.gen, kind, seq, rank)
+
+    # -- core exchange --
+    def _exchange(self, kind, payload, roots=None):
+        """Publish ``payload`` under this op's sequence number, collect
+        every member's payload (or only ``roots``'), GC the trailing
+        sequence. Raises WorkerLostError naming the dead ranks if a
+        peer's key never lands and its heartbeat is stale."""
+        act = _faults.fire("kv.worker_die")
+        if act == "die":
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        seq = self.seq
+        self.seq += 1
+        mine = self._key(kind, seq, self.rank)
+        # 2-byte frame: a stored value SHORTER THAN 2 BYTES segfaults
+        # this jaxlib's key_value_dir_get binding, and broadcast
+        # non-roots publish b"" — so every exchange payload is framed to
+        # at least 2 bytes on the plane (stripped in _fetch)
+        if isinstance(payload, str):
+            payload = payload.encode()
+        self.client.set(mine, b"MX" + payload)
+        self._published.append((seq, [mine]))
+        out = {self.rank: payload}
+        want = self.members if roots is None else \
+            [r for r in roots if r != self.rank]
+        for r in want:
+            if r == self.rank:
+                continue
+            out[r] = self._fetch(kind, seq, r)
+        # trailing-edge GC: by BSP lockstep, once THIS op completed
+        # everywhere a key two ops old has been read by every peer
+        while self._published and self._published[0][0] <= seq - 2:
+            _, keys = self._published.pop(0)
+            for k in keys:
+                self.client.delete(k)
+        return out
+
+    def _fetch(self, kind, seq, r):
+        key = self._key(kind, seq, r)
+        deadline = time.time() + self.op_timeout
+        reform_prefix = "%s/reform/%d/prop/" % (self.ns, self.gen + 1)
+        while True:
+            act = _faults.fire("kv.partition")
+            if act == "drop":
+                # a dropped control-plane message: requeue the read —
+                # falling THROUGH to the deadline check, so a persistent
+                # partition ends in the timeout below, never a spin
+                DIST_HEALTH.requeued += 1
+            else:
+                v = self.client.get(key)
+                if v is not None:
+                    return v[2:]  # strip the exchange frame bytes
+                # a peer already gave up on this generation: join the
+                # re-form instead of waiting on traffic that never comes
+                if self.client.dir(reform_prefix):
+                    self._lost([],
+                               "re-form of generation %d already proposed"
+                               % (self.gen + 1))
+                if not self.client.alive(r):
+                    self._lost([r], "no heartbeat and no g%d/%s/%d key"
+                               % (self.gen, kind, seq))
+            if time.time() >= deadline:
+                from .kvstore import KVStoreTimeoutError
+                raise KVStoreTimeoutError(
+                    "ring %s op (gen %d seq %d) timed out after %.0fs "
+                    "waiting on rank %d" % (kind, self.gen, seq,
+                                            self.op_timeout, r),
+                    started=True)
+            if self.poll:
+                time.sleep(self.poll)
+
+    def _lost(self, dead, why):
+        from .kvstore import WorkerLostError
+        self.dead = tuple(sorted(dead))
+        DIST_HEALTH.worker_lost += 1
+        DIST_HEALTH.last_dead = self.dead
+        table = self.liveness_table()
+        _flight_dump("ring worker lost (gen %d): %s" % (self.gen, why),
+                     extra={"liveness": table, "generation": self.gen,
+                            "members": list(self.members)})
+        raise WorkerLostError(
+            "worker(s) %s lost from ring generation %d (%s); liveness=%s"
+            % (list(self.dead) or "?", self.gen, why, table))
+
+    # -- collectives --
+    def allreduce_sum(self, arr):
+        """Deterministic cross-worker sum: every member's array, summed
+        in member order (bitwise-identical on every worker)."""
+        arr = np.asarray(arr)
+        if self.size == 1:
+            return arr.copy()
+        got = self._exchange("red", _encode_array(arr))
+        out = None
+        for r in self.members:
+            a = arr if r == self.rank else _decode_array(got[r])
+            out = a.copy() if out is None else out + a
+        return out
+
+    def broadcast_bytes(self, payload, root_index=0):
+        """Raw-bytes broadcast from the member at ``root_index``."""
+        root = self.members[root_index]
+        if self.size == 1:
+            return payload
+        data = payload if self.rank == root else b""
+        got = self._exchange("bcast", data)
+        return got[root]
+
+    def broadcast(self, arr=None, root_index=0):
+        root = self.members[root_index]
+        if self.size == 1:
+            return np.asarray(arr)
+        data = _encode_array(arr) if self.rank == root else b""
+        got = self._exchange("bcast", data)
+        return np.asarray(arr) if self.rank == root \
+            else _decode_array(got[root])
+
+    def barrier(self):
+        if self.size > 1:
+            self._exchange("bar", b"1")
+
+    # -- re-form protocol --
+    def reform(self, extra_members=(), timeout=None):
+        """Re-form the ring around the live members (plus any pending
+        joiners). First-write-wins proposals under
+        ``<ns>/reform/<gen+1>/prop/<attempt>`` converge every survivor
+        on ONE membership; all-member acks double as the commit
+        barrier. Returns the new member list.
+
+        A member that died mid-reform is handled by attempt
+        escalation: any member that sees a dead rank in the current
+        proposal (and leads the live set) proposes attempt+1, and
+        ack-waiters abort to the newer attempt.
+        """
+        gen2 = self.gen + 1
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self.op_timeout)
+        prop_prefix = "%s/reform/%d/prop/" % (self.ns, gen2)
+        joiners = set(int(j) for j in extra_members)
+        joiners |= set(self.poll_joiners())
+
+        while True:
+            if time.time() >= deadline:
+                from .kvstore import KVStoreTimeoutError
+                raise KVStoreTimeoutError(
+                    "ring re-form to generation %d did not converge "
+                    "within %.0fs" % (gen2, self.op_timeout), started=True)
+            live = sorted(r for r in self.members
+                          if r == self.rank or self.client.alive(r))
+            props = self.client.dir(prop_prefix)
+            attempts = sorted(int(k.rsplit("/", 1)[1]) for k in props)
+            if not attempts:
+                if self.rank == min(live):
+                    prop = sorted(set(live) | joiners)
+                    self.client.set(
+                        prop_prefix + "0",
+                        json.dumps({"members": prop,
+                                    "joiners": sorted(joiners)}),
+                        overwrite=False)
+                if self.poll:
+                    time.sleep(self.poll)
+                continue
+            att = attempts[-1]
+            d = json.loads(props[prop_prefix + "%d" % att].decode())
+            members = [int(m) for m in d["members"]]
+            # the PROPOSAL's joiner list is the authoritative one: a
+            # member whose own poll raced the join request must still
+            # reach the same verdict as everyone else
+            prop_joiners = set(int(j) for j in d.get("joiners", []))
+            if self.rank not in members:
+                self._lost([self.rank],
+                           "this rank was evicted by re-form attempt %d"
+                           % att)
+            stale = [r for r in members
+                     if r != self.rank and r not in prop_joiners
+                     and not self.client.alive(r)]
+            if stale:
+                if self.rank == min(r for r in live if r in members):
+                    prop = sorted((set(members) - set(stale)) | joiners)
+                    self.client.set(
+                        prop_prefix + "%d" % (att + 1),
+                        json.dumps({"members": prop,
+                                    "joiners": sorted(joiners)}),
+                        overwrite=False)
+                if self.poll:
+                    time.sleep(self.poll)
+                continue
+            # joiners don't ack — they learn the membership only from the
+            # commit ticket; the barrier is across incumbents
+            if self._ack_and_wait(
+                    gen2, att,
+                    [m for m in members if m not in prop_joiners],
+                    deadline):
+                self._commit(gen2, members, sorted(prop_joiners))
+                return list(self.members)
+            # a newer attempt superseded this one; loop and re-read
+
+    def _ack_and_wait(self, gen2, att, members, deadline):
+        ack = "%s/reform/%d/ack/%d/" % (self.ns, gen2, att)
+        # "ok", not "1": sub-2-byte values segfault jaxlib's dir-get
+        self.client.set(ack + "%d" % self.rank, b"ok")
+        newer = "%s/reform/%d/prop/%d" % (self.ns, gen2, att + 1)
+        while True:
+            have = self.client.dir(ack)
+            if all((ack + "%d" % r) in have for r in members):
+                return True
+            if self.client.get(newer) is not None:
+                return False
+            if time.time() >= deadline:
+                from .kvstore import KVStoreTimeoutError
+                raise KVStoreTimeoutError(
+                    "re-form ack wait (gen %d attempt %d) timed out"
+                    % (gen2, att), started=True)
+            if self.poll:
+                time.sleep(self.poll)
+
+    def _commit(self, gen2, members, joiners):
+        old = list(self.members)
+        self.gen = gen2
+        self.seq = 0
+        self.members = sorted(members)
+        self.dead = ()
+        self._published = []
+        DIST_HEALTH.reforms += 1
+        DIST_HEALTH.workers = len(self.members)
+        DIST_HEALTH.generation = self.gen
+        # the new leader publishes the admission ticket for each joiner
+        # and clears their requests
+        if joiners and self.rank == self.members[0]:
+            for j in joiners:
+                self.client.set(
+                    "%s/joined/%d" % (self.ns, j),
+                    json.dumps({"gen": self.gen, "members": self.members}))
+                self.client.delete("%s/join/%d" % (self.ns, j))
+        _flight_dump(
+            "ring re-formed: generation %d" % self.gen,
+            extra={"members": list(self.members), "was": old,
+                   "joiners": list(joiners),
+                   "liveness": self.liveness_table()})
+
+    # -- join protocol (late worker, epoch boundary) --
+    def request_join(self, timeout=None):
+        """Called by a late/rejoining worker: announce, then wait for an
+        incumbent re-form to admit us. Adopts the committed generation
+        and membership; the caller then warm-pulls current params
+        (kvstore broadcast) before taking its first step."""
+        # "ok", not "1": sub-2-byte values segfault jaxlib's dir-get
+        self.client.set("%s/join/%d" % (self.ns, self.rank), b"ok")
+        DIST_HEALTH.joins += 1
+        key = "%s/joined/%d" % (self.ns, self.rank)
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self.op_timeout)
+        while True:
+            v = self.client.get(key)
+            if v is not None:
+                d = json.loads(v.decode())
+                self.gen = int(d["gen"])
+                self.seq = 0
+                self.members = sorted(int(m) for m in d["members"])
+                self._published = []
+                self.client.delete(key)
+                DIST_HEALTH.workers = len(self.members)
+                DIST_HEALTH.generation = self.gen
+                return list(self.members)
+            if time.time() >= deadline:
+                from .kvstore import KVStoreTimeoutError
+                raise KVStoreTimeoutError(
+                    "join request was not admitted within %.0fs"
+                    % self.op_timeout, started=True)
+            if self.poll:
+                time.sleep(self.poll)
+
+    def poll_joiners(self):
+        """Ranks currently requesting admission (non-blocking)."""
+        prefix = "%s/join/" % self.ns
+        out = []
+        for k in self.client.dir(prefix):
+            try:
+                out.append(int(k.rsplit("/", 1)[1]))
+            except ValueError:
+                pass
+        return sorted(r for r in out if r not in self.members)
+
+
+# --------------------------------------------------------------------------
+# process-global ring over the jax coordination service
+# --------------------------------------------------------------------------
+
+_shared = {}
+
+
+def shared_ring():
+    """The ONE process-wide ring over jax's coordination service (every
+    dist kvstore shares it, so the BSP sequence stream is unified).
+    Returns None when single-process."""
+    r = _shared.get("ring")
+    if r is not None:
+        return r
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    from jax._src.distributed import global_state
+    client = getattr(global_state, "client", None)
+    if client is None:
+        raise MXNetError("dist kvstore requires jax.distributed.initialize "
+                         "(tools/launch.py sets MXTPU_COORD/RANK/NPROC)")
+    ring = Ring(CoordClient(client), jax.process_index(),
+                range(jax.process_count()))
+    _shared["ring"] = ring
+    return ring
+
+
+def _reset_shared_ring():
+    _shared.pop("ring", None)

@@ -66,6 +66,22 @@ site                         where it fires
                              which surfaces as consumer stall fraction in
                              ``data.PipelineStats`` without ever
                              reordering batches
+``kv.worker_die``            top of every ``dist_ring.Ring`` collective —
+                             ``"die"`` SIGKILLs this process mid-exchange
+                             (the elastic-membership drill: survivors see
+                             a dead heartbeat, raise ``WorkerLostError``,
+                             and re-form at N-1); raising kinds propagate
+                             to the caller instead
+``kv.push_delay``            before a dist push (sync and async stores) —
+                             a ``"delay"`` rule makes this worker a
+                             straggler, which the SSP window surfaces as
+                             ``staleness_lag`` on its peers
+``kv.partition``             per peer-key poll inside a ring fetch —
+                             ``"drop"`` discards that poll (a lossy /
+                             partitioned control link); finite rules heal
+                             and count ``DIST_HEALTH.requeued``, a
+                             persistent rule ends in
+                             ``KVStoreTimeoutError``, never a hang
 ===========================  ==============================================
 
 Rule kinds:

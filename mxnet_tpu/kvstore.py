@@ -12,13 +12,19 @@ substrate:
   reduce is one fused XLA sum — and in the Module fast path gradients never
   pass through host memory at all.
 - 'dist_sync'/'dist_device_sync': the reference's ps-lite parameter server
-  (ZMQ push/pull to sharded servers) is replaced by SPMD collectives —
-  ``jax.lax.psum`` over the ICI/DCN mesh inside the pjit-ed train step (see
-  mxnet_tpu.parallel). This KVStore front-end keeps rank/num_workers/barrier
-  semantics over ``jax.distributed`` for the host-side control plane.
-- 'dist_async': intentionally NOT supported — fully-async parameter-server
-  updates have no idiomatic TPU/SPMD analog (documented gap, SURVEY §5);
-  a clear error explains the substitute.
+  (ZMQ push/pull to sharded servers) is replaced by the control-plane ring
+  (:mod:`mxnet_tpu.dist_ring`): cross-process aggregation is a
+  deterministic KV-plane allreduce whose every wait aborts when a peer's
+  heartbeat goes stale — a lost worker surfaces as
+  :class:`WorkerLostError` in bounded time and the survivors can re-form
+  at N-1 (docs/robustness.md "Elastic distributed training"). The legacy
+  global-mesh psum transport survives behind
+  ``MXTPU_DIST_TRANSPORT=mesh``.
+- 'dist_async': bounded-staleness (SSP) push/pull — each worker's pushes
+  carry a version; pull blocks ONLY while this worker is more than
+  ``MXTPU_KV_STALENESS`` versions ahead of the slowest live peer (the
+  reference's fully-async PS, made convergence-safe the Stale Synchronous
+  Parallel way).
 """
 from __future__ import annotations
 
@@ -28,7 +34,7 @@ import pickle
 import threading
 import time
 
-from .base import MXNetError, NotImplementedForTPU
+from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 
@@ -369,6 +375,11 @@ class _Heartbeat(object):
         key = self.KEY % self.rank
         stamp = repr(time.time())
         try:
+            from .dist_ring import DIST_HEALTH
+            DIST_HEALTH.heartbeats += 1
+        except Exception:
+            pass
+        try:
             client.key_value_set(key, stamp, allow_overwrite=True)
         except TypeError:            # older jaxlib: no overwrite kwarg
             try:
@@ -394,18 +405,30 @@ class _Heartbeat(object):
         # does silence-from-birth count as death.
         grace = (self.startup_grace if self.startup_grace is not None
                  else timeout_sec)
+        # ONE dir scan returns every published beat (this jaxlib has no
+        # key_value_try_get; per-key blocking reads would serialize N
+        # timeouts)
+        stamps = {}
+        try:
+            got = client.key_value_dir_get(self.KEY.rsplit("%", 1)[0])
+            items = got.items() if hasattr(got, "items") else got
+            for k, v in items:
+                try:
+                    stamps[int(str(k).rsplit("/", 1)[1])] = float(v)
+                except (ValueError, IndexError):
+                    pass
+        except Exception:
+            return 0                 # plane unreadable: cannot judge peers
         dead = 0
         for r in range(size):
             if r == self.rank:
                 continue
-            try:
-                v = client.key_value_try_get(self.KEY % r)
+            if r in stamps:
                 self._seen.add(r)
-                if now - float(v) > timeout_sec:
+                if now - stamps[r] > timeout_sec:
                     dead += 1
-            except Exception:        # no beat published for this rank
-                if r in self._seen or now - self._started > grace:
-                    dead += 1
+            elif r in self._seen or now - self._started > grace:
+                dead += 1
         return dead
 
     def stop(self):
@@ -431,9 +454,16 @@ class KVStoreDistSync(KVStore):
     """BSP data-parallel store over the jax.distributed control plane.
 
     Within one process this behaves exactly like 'local'; across processes
-    (multi-host pods) gradient aggregation itself rides the in-step psum
-    (mxnet_tpu.parallel.grad_sync) — this object supplies rank/size/barrier
-    (ref semantics: kvstore_dist.h sync mode, kvstore_dist_server.h:164-198).
+    the locally-reduced value is summed over the control-plane ring
+    (:mod:`mxnet_tpu.dist_ring`) — deterministic member-order sum, so
+    every worker computes the bitwise-identical aggregate (ref semantics:
+    kvstore_dist.h sync mode, kvstore_dist_server.h:164-198). The ring is
+    also what makes the store ELASTIC: any wait on a dead peer raises
+    :class:`WorkerLostError` in bounded time, and :meth:`reform` rebuilds
+    the membership at N-1 so fit can continue (docs/robustness.md
+    "Elastic distributed training"). ``MXTPU_DIST_TRANSPORT=mesh``
+    selects the legacy global-device-mesh psum transport instead (needs
+    Gloo on CPU; NOT elastic — a dead peer wedges the collective).
     """
 
     def __init__(self, kv_type="dist_sync"):
@@ -441,8 +471,16 @@ class KVStoreDistSync(KVStore):
         self._rank, self._size = _dist_rank_size()
         self._gmesh = None
         self._sum_fn = None
+        self._transport = os.environ.get("MXTPU_DIST_TRANSPORT", "ring")
+        self._ring = None
+        if self._size > 1 and self._transport != "mesh":
+            from .dist_ring import shared_ring
+            self._ring = shared_ring()
         self._heartbeat = (_shared_heartbeat(self._rank)
                            if self._size > 1 else None)
+        self.max_reforms = int(_env_float("MXTPU_KV_MAX_REFORMS", 2))
+        #: dist_sync is BSP: nobody is ever stale (Speedometer suffix)
+        self.staleness_lag = 0
 
     def num_dead_node(self, node_id, timeout_sec=60):
         """Count workers whose coordination-service heartbeat is stale
@@ -457,25 +495,54 @@ class KVStoreDistSync(KVStore):
 
     @property
     def num_workers(self):
+        """LIVE worker count: the ring membership size, which shrinks on
+        re-form (rescale_grad and throughput scaling read this)."""
+        if self._ring is not None:
+            return len(self._ring.members)
         return self._size
 
+    @property
+    def worker_index(self):
+        """This worker's logical position in the live membership — the
+        data-shard index. ``rank`` stays the immutable process id;
+        after a re-form the surviving ranks re-pack into 0..N-2 HERE."""
+        if self._ring is not None:
+            return self._ring.index
+        return self._rank
+
+    @property
+    def reforms(self):
+        """Ring re-forms survived so far (== ring generation)."""
+        return self._ring.gen if self._ring is not None else 0
+
     def _barrier(self):
-        if self._size > 1:
-            import jax
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+        if self._size == 1:
+            return
+        if self._ring is not None:
+            self._ring.barrier()
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+    def _do_push(self, key, value, priority=0):
+        from . import faults as _faults
+        # "delay" rules sleep inside fire(): a slow network push
+        _faults.fire("kv.push_delay")
+        super()._do_push(key, value, priority)
 
     # ------------------------------------------------------------------
     def _cross_sum(self, value):
         """Sum a host value across all worker processes (the ps-lite server
-        aggregation, ref kvstore_dist_server.h:164-198, as one XLA
-        reduction over the global device mesh). BSP contract: every worker
-        must call push with the same keys in the same order."""
+        aggregation, ref kvstore_dist_server.h:164-198). BSP contract:
+        every worker must call push with the same keys in the same
+        order."""
         if self._size == 1:
             return value
-        import jax
         import jax.numpy as jnp
         import numpy as np
+        if self._ring is not None:
+            return jnp.asarray(self._ring.allreduce_sum(np.asarray(value)))
+        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         if self._gmesh is None:
             from .parallel.mesh import global_data_mesh
@@ -506,12 +573,347 @@ class KVStoreDistSync(KVStore):
         if self._size == 1:
             return value
         import jax.numpy as jnp
+        if self._ring is not None:
+            import numpy as np
+            arr = self._ring.broadcast(np.asarray(value.data), root_index=0)
+            value._set_data(jnp.asarray(arr))
+            return value
         from .parallel.mesh import global_data_mesh, host_broadcast0
         if self._gmesh is None:
             self._gmesh = global_data_mesh("worker")
         value._set_data(jnp.asarray(host_broadcast0(self._gmesh,
                                                     value.data)))
         return value
+
+    # ------------------------------------------------------------------
+    # elastic membership (docs/robustness.md "Elastic distributed
+    # training")
+    def grad_reduce(self, vec):
+        """Cross-worker sum of a flat host gradient vector — the fused
+        TrainStep's in-scan host hook (ring transport only)."""
+        if self._ring is None:
+            return vec
+        return self._ring.allreduce_sum(vec)
+
+    def broadcast_bytes(self, payload, root_index=0):
+        """Raw-bytes broadcast from the logical leader (checkpoint
+        adoption after a re-form)."""
+        if self._ring is None:
+            return payload
+        return self._ring.broadcast_bytes(payload, root_index=root_index)
+
+    def reform(self):
+        """Re-form the ring around the live members (plus any pending
+        joiners); returns the new member list. Raises WorkerLostError
+        when the store has no elastic transport, and surfaces (with a
+        flight dump) once ``max_reforms`` (MXTPU_KV_MAX_REFORMS) is
+        exhausted — callers check :attr:`reforms` BEFORE invoking."""
+        if self._ring is None:
+            raise WorkerLostError(
+                "worker lost and no elastic transport: the '%s' transport "
+                "cannot re-form (use MXTPU_DIST_TRANSPORT=ring)"
+                % self._transport)
+        return self._ring.reform()
+
+    def pending_joiners(self):
+        return self._ring.poll_joiners() if self._ring is not None else []
+
+    def join(self, timeout=None):
+        """Late-worker entry: request admission and block until the
+        incumbents re-form us in at an epoch boundary; then warm-pull
+        current params (kvstore broadcast) before the first step."""
+        if self._ring is None:
+            raise WorkerLostError("join requires the ring transport")
+        return self._ring.request_join(timeout)
+
+    def liveness_table(self):
+        return (self._ring.liveness_table()
+                if self._ring is not None else {})
+
+
+class KVStoreDistAsync(KVStore):
+    """Bounded-staleness (SSP) push/pull — the reference's fully-async
+    parameter server (src/kvstore/kvstore_dist_server.h async mode) made
+    convergence-safe the Stale Synchronous Parallel way.
+
+    Every worker owns a per-key record on the control plane:
+    ``(version, last_push, cumulative_sum)``, overwritten in place on
+    each push (one key per worker per parameter — no unbounded queue).
+    ``push`` never blocks. ``pull`` blocks ONLY while this worker is
+    more than S = ``MXTPU_KV_STALENESS`` versions ahead of the slowest
+    LIVE peer (dead laggards are dropped from the window — async
+    training tolerates loss by design); a persistent stall ends in
+    :class:`KVStoreTimeoutError`, never a hang.
+
+    Aggregation at pull time: with an updater the store applies
+    ``delta = sum_of_visible_cumulatives - already_applied`` (each
+    worker's contribution lands exactly once, whatever interleaving);
+    without one the store becomes the sum of each worker's latest
+    visible push (the dist_sync closed form when everyone has pushed
+    the same number of times).
+
+    ``_plane=(client, rank, size)`` injects an in-memory control plane
+    for tier-1 thread tests; real runs derive it from
+    ``jax.distributed``.
+    """
+
+    def __init__(self, kv_type="dist_async", _plane=None, _ns="mxasync"):
+        super().__init__(kv_type)
+        self._ns = _ns
+        if _plane is not None:
+            self._client, self._rank, self._size = _plane
+            self._heartbeat = None
+        else:
+            self._rank, self._size = _dist_rank_size()
+            self._client = None
+            self._heartbeat = None
+            if self._size > 1:
+                from .dist_ring import CoordClient
+                from jax._src.distributed import global_state
+                self._client = CoordClient(global_state.client)
+                self._heartbeat = _shared_heartbeat(self._rank)
+        self.staleness = int(_env_float("MXTPU_KV_STALENESS", 4))
+        self._poll = _env_float("MXTPU_DIST_POLL", 0.005)
+        self._pull_timeout = _env_float("MXTPU_DIST_OP_TIMEOUT", 120.0)
+        self._ver = {}        # key -> this worker's push count
+        self._last = {}       # key -> np array of the latest local push
+        self._cum = {}        # key -> np cumulative sum of local pushes
+        self._applied = {}    # key -> np total already folded into store
+        self._dead_ranks = set()
+        self.staleness_lag = 0
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size - len(self._dead_ranks)
+
+    @property
+    def worker_index(self):
+        return self._rank
+
+    def num_dead_node(self, node_id, timeout_sec=60):
+        if self._heartbeat is not None:
+            return self._heartbeat.dead_nodes(self._size, timeout_sec)
+        return len(self._dead_ranks)
+
+    # -- control-plane records --
+    def _kpath(self, kind, k, rank=None):
+        p = "%s/%s/%s" % (self._ns, kind, k)
+        return p if rank is None else p + "/%d" % rank
+
+    @staticmethod
+    def _enc_state(ver, last, cum):
+        import io as _io
+        import struct
+        import numpy as np
+        bio = _io.BytesIO()
+        bio.write(struct.pack("<q", int(ver)))
+        np.lib.format.write_array(bio, np.ascontiguousarray(last),
+                                  allow_pickle=False)
+        np.lib.format.write_array(bio, np.ascontiguousarray(cum),
+                                  allow_pickle=False)
+        return bio.getvalue()
+
+    @staticmethod
+    def _dec_state(data):
+        import io as _io
+        import struct
+        import numpy as np
+        bio = _io.BytesIO(data)
+        ver = struct.unpack("<q", bio.read(8))[0]
+        last = np.lib.format.read_array(bio, allow_pickle=False)
+        cum = np.lib.format.read_array(bio, allow_pickle=False)
+        return ver, last, cum
+
+    def _publish_state(self, k):
+        if self._client is None:
+            return
+        self._client.set(self._kpath("v", k, self._rank),
+                         self._enc_state(self._ver[k], self._last[k],
+                                         self._cum[k]))
+
+    def _peer_states(self, k):
+        """Latest-visible (version, last, cum) per rank — DEAD ranks
+        included: their landed contributions stay in the aggregate (only
+        the staleness window stops gating on them). An unpublished rank
+        reads as version 0 with zero contributions."""
+        import numpy as np
+        zero = np.zeros_like(self._cum[k])
+        out = {r: (0, zero, zero) for r in range(self._size)}
+        out[self._rank] = (self._ver[k], self._last[k], self._cum[k])
+        if self._client is None:
+            return out
+        for key, data in self._client.dir(self._kpath("v", k) + "/").items():
+            try:
+                r = int(key.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            if r == self._rank:
+                continue
+            out[r] = self._dec_state(data)
+        return out
+
+    # -- init/push/pull --
+    def init(self, key, value):
+        import numpy as np
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("init: key %r already initialized" % (k,))
+            v = vlist[0].copy()
+            if self._client is not None and self._size > 1:
+                # rank 0's copy is authoritative (the server's single
+                # stored weight, ref kvstore_dist_server.h)
+                ikey = self._kpath("init", k)
+                if self._rank == 0:
+                    from .dist_ring import _encode_array
+                    self._client.set(ikey,
+                                     _encode_array(np.asarray(v.data)))
+                else:
+                    import jax.numpy as jnp
+                    from .dist_ring import _decode_array
+                    data = self._blocking_get(ikey)
+                    v._set_data(jnp.asarray(_decode_array(data)))
+            arr = np.asarray(v.data)
+            self._store[k] = v
+            self._ver[k] = 0
+            self._last[k] = np.zeros_like(arr)
+            self._cum[k] = np.zeros_like(arr)
+            self._applied[k] = np.zeros_like(arr)
+            self._publish_state(k)
+
+    def _blocking_get(self, key):
+        deadline = time.monotonic() + self._pull_timeout
+        while True:
+            v = self._client.get(key)
+            if v is not None:
+                return v
+            if time.monotonic() >= deadline:
+                raise KVStoreTimeoutError(
+                    "dist_async: %s not published within %.0fs (is rank 0 "
+                    "up?)" % (key, self._pull_timeout), started=True)
+            if self._poll:
+                time.sleep(self._poll)
+
+    def _do_push(self, key, value, priority=0):
+        from . import faults as _faults
+        import numpy as np
+        _faults.fire("kv.push_delay")
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("push: key %r not initialized" % (k,))
+            merged = vlist[0].data
+            for v in vlist[1:]:
+                merged = merged + v.data
+            m = np.asarray(merged)
+            self._ver[k] += 1
+            self._last[k] = m
+            self._cum[k] = self._cum[k] + m
+            self._publish_state(k)       # overwrite in place; NON-blocking
+
+    def _do_pull(self, key, out, priority=0):
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("pull: key %r not initialized" % (k,))
+            self._refresh(k)
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    def _refresh(self, k):
+        """Enforce the staleness window, then fold the visible state of
+        every live peer into the stored value."""
+        import numpy as np
+        deadline = time.monotonic() + self._pull_timeout
+        while True:
+            states = self._peer_states(k)
+            # the window gates on LIVE peers only; dead ranks' landed
+            # contributions still aggregate below
+            min_ver = min(v[0] for r, v in states.items()
+                          if r not in self._dead_ranks)
+            lag = self._ver[k] - min_ver
+            self.staleness_lag = max(0, lag)
+            try:
+                from .dist_ring import DIST_HEALTH
+                DIST_HEALTH.staleness_lag = self.staleness_lag
+            except Exception:
+                pass
+            if lag <= self.staleness:
+                break
+            laggards = [r for r, v in states.items()
+                        if self._ver[k] - v[0] > self.staleness
+                        and r != self._rank and r not in self._dead_ranks]
+            dead = [r for r in laggards
+                    if self._client is not None
+                    and not self._client.alive(r)]
+            if dead:
+                # async tolerates loss: a dead laggard stops gating the
+                # window (its landed contributions remain in the sums)
+                logging.warning(
+                    "dist_async: dropping dead laggard worker(s) %s from "
+                    "the staleness window for key %r", dead, k)
+                self._dead_ranks.update(dead)
+                continue
+            if time.monotonic() >= deadline:
+                raise KVStoreTimeoutError(
+                    "dist_async pull: worker %d is %d versions ahead of "
+                    "the slowest peer (window S=%d) and no progress for "
+                    "%.0fs" % (self._rank, lag, self.staleness,
+                               self._pull_timeout), started=True)
+            if self._poll:
+                time.sleep(self._poll)
+        ranks = sorted(states)
+        if self._updater is not None:
+            total = None
+            for r in ranks:
+                c = states[r][2]
+                total = c.copy() if total is None else total + c
+            delta = total - self._applied[k]
+            if np.any(delta != 0):
+                import jax.numpy as jnp
+                self._updater(k, NDArray(jnp.asarray(delta)),
+                              self._store[k])
+            self._applied[k] = total
+        else:
+            pushed = [states[r][1] for r in ranks if states[r][0] > 0]
+            if pushed:
+                import jax.numpy as jnp
+                total = None
+                for p in pushed:
+                    total = p.copy() if total is None else total + p
+                self._store[k]._set_data(jnp.asarray(total))
+
+    def _barrier(self):
+        """Best-effort KV barrier (async training rarely needs one; the
+        dist launcher scripts use it around setup/teardown)."""
+        if self._client is None or self._size <= 1:
+            return
+        self._bar_n = getattr(self, "_bar_n", 0) + 1
+        prefix = "%s/bar/%d/" % (self._ns, self._bar_n)
+        # "ok", not "1": sub-2-byte values segfault jaxlib's dir-get
+        self._client.set(prefix + "%d" % self._rank, b"ok")
+        deadline = time.monotonic() + self._pull_timeout
+        while True:
+            have = self._client.dir(prefix)
+            missing = [r for r in range(self._size)
+                       if r not in self._dead_ranks
+                       and (prefix + "%d" % r) not in have]
+            if not missing:
+                return
+            for r in list(missing):
+                if not self._client.alive(r):
+                    self._dead_ranks.add(r)
+            if time.monotonic() >= deadline:
+                raise KVStoreTimeoutError(
+                    "dist_async barrier %d: missing ranks %s"
+                    % (self._bar_n, missing), started=True)
+            if self._poll:
+                time.sleep(self._poll)
 
 
 def _dist_rank_size():
@@ -540,15 +942,15 @@ def create(name="local"):
 
     'local'/'device' — single-process multi-device (device-side reduce is
     automatic on the XLA substrate, so both names share one impl).
-    'dist_sync'/'dist_device_sync' — BSP over jax.distributed + in-step psum.
-    'dist_async' — unsupported on TPU (see module docstring).
+    'dist_sync'/'dist_device_sync' — BSP over jax.distributed + the
+    control-plane ring (elastic; see KVStoreDistSync).
+    'dist_async' — bounded-staleness SSP push/pull (see KVStoreDistAsync;
+    window MXTPU_KV_STALENESS).
     """
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     if "async" in name:
-        raise NotImplementedForTPU(
-            "dist_async parameter-server semantics have no TPU/SPMD analog; "
-            "use dist_sync (BSP via psum over ICI). See SURVEY.md section 5.")
+        return KVStoreDistAsync(name)
     if "dist" in name:
         return KVStoreDistSync(name)
     if name in ("local", "device", "local_allreduce_cpu",

@@ -24,6 +24,16 @@ def _init_distributed():
     if not coord:
         return False
     import jax
+    # CPU multi-process needs a collectives implementation for the legacy
+    # global-mesh transport (MXTPU_DIST_TRANSPORT=mesh): Gloo, configured
+    # BEFORE the backend exists. Harmless for the default ring transport
+    # (whose jits stay process-local); MXTPU_DIST_GLOO=0 opts out.
+    if os.environ.get("MXTPU_DIST_GLOO", "1") != "0" \
+            and os.environ.get("JAX_PLATFORMS", "").strip() in ("cpu", ""):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # jaxlib without Gloo: ring transport still works
     kwargs = dict(
         coordinator_address=coord,
         num_processes=int(os.environ.get("MXTPU_NPROC", "1")),

@@ -824,6 +824,53 @@ class CheckpointManager(object):
             return st
         return None
 
+    # -- elastic checkpoint adoption (docs/robustness.md "Elastic
+    # distributed training") -------------------------------------------
+    def export_latest(self):
+        """Serialize the newest known-good checkpoint — manifest plus
+        every file it lists, plus the symbol file when present — into one
+        bytes blob for a ring broadcast (the re-form leader's state
+        adoption). Returns ``b""`` when nothing loadable exists."""
+        import pickle
+        st = self.load_latest()
+        if st is None:
+            return b""
+        base_dir = os.path.dirname(os.path.abspath(self.prefix))
+        payload = {"tag": st.tag, "manifest": st.manifest, "files": {}}
+        for info in st.manifest.get("files", {}).values():
+            path = os.path.join(base_dir, info["name"])
+            with open(path, "rb") as f:
+                payload["files"][info["name"]] = f.read()
+        sym_f = "%s-symbol.json" % self.prefix
+        if os.path.exists(sym_f):
+            with open(sym_f, "rb") as f:
+                payload["files"][os.path.basename(sym_f)] = f.read()
+        return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def import_blob(self, blob):
+        """Install a checkpoint exported by :meth:`export_latest` under
+        THIS manager's directory: every file atomically, the manifest
+        second-to-last, the ``latest`` pointer last — the same durability
+        order as a native save, so a crash mid-import never publishes a
+        partial checkpoint. Returns the installed tag."""
+        import pickle
+        payload = pickle.loads(blob)
+        base_dir = os.path.dirname(os.path.abspath(self.prefix))
+        manifest = payload["manifest"]
+        listed = {i["name"] for i in manifest.get("files", {}).values()}
+        for name, data in payload["files"].items():
+            if name in listed:
+                atomic_write_bytes(os.path.join(base_dir, name), data)
+            else:  # symbol file: shared across tags, first-write-wins
+                path = os.path.join(base_dir, name)
+                if not os.path.exists(path):
+                    atomic_write_bytes(path, data)
+        atomic_write_bytes(self._file(payload["tag"], "manifest.json"),
+                           json.dumps(manifest, indent=1).encode())
+        atomic_write_bytes(self.latest_path, payload["tag"].encode())
+        self.logger.info("Adopted broadcast checkpoint %s", payload["tag"])
+        return payload["tag"]
+
     # -- retention -----------------------------------------------------
     def _read_manifest(self, tag):
         try:

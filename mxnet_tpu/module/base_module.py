@@ -406,6 +406,13 @@ class BaseModule(object):
         pl_depth = max(0, int(pl_depth))
         if k <= 1 or fused_dispatch is None:
             pl_depth = 0
+        if getattr(self, "_is_dist_kvstore", lambda: False)():
+            # elastic dist training (docs/robustness.md): every dispatch
+            # already blocks on the cross-process reduction so a peer
+            # failure surfaces AT its dispatch — a deferred-readback
+            # window would only widen the state a WorkerLostError has to
+            # discard at re-form time
+            pl_depth = 0
         pipeline = _DispatchPipeline(pl_depth)
         if k > 1:
             # device-fed input tier (docs/perf.md "Device-fed input
@@ -471,6 +478,7 @@ class BaseModule(object):
         # start so the FIRST retired dispatch's counter delta covers that
         # dispatch, not "everything since the process began"
         from ..obs import flight as _obs_flight
+        from ..kvstore import WorkerLostError as _WorkerLost
         _obs_flight.note("fit_start", epoch=begin_epoch)
         try:
             epoch = begin_epoch
@@ -637,6 +645,25 @@ class BaseModule(object):
                         # data order (reset() alone advances it by one)
                         iter_set_epoch(epoch)
                     continue
+                except _WorkerLost as wle:
+                    # elastic membership (docs/robustness.md "Elastic
+                    # distributed training"): a peer died mid-epoch —
+                    # discard in-flight dispatches (their cross-worker
+                    # reductions never completed), seal an emergency
+                    # checkpoint, re-form the ring at N-1, adopt the
+                    # leader's state, and re-enter the epoch loop exactly
+                    # like a resume
+                    _obs_trace.instant("worker_lost", epoch=epoch,
+                                       nbatch=nbatch)
+                    pipeline.discard()
+                    resume_state = self._elastic_reform(
+                        wle, ckpt_mgr, guard, eval_metric, epoch, nbatch,
+                        train_data)
+                    epoch = resume_state.epoch
+                    train_iter.reset()
+                    if iter_set_epoch is not None:
+                        iter_set_epoch(epoch)
+                    continue
 
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -683,6 +710,10 @@ class BaseModule(object):
                                          nbatch=0):
                         ckpt_mgr.save(self, epoch + 1, 0)
                     ckpt_mgr.drain()
+                # epoch boundary is the ONLY admission point for late
+                # joiners: a mid-epoch join would change the gradient
+                # denominator between checkpoints
+                self._admit_dist_joiners(ckpt_mgr, train_data)
                 if train_iter is train_data or epoch < num_epoch - 1:
                     train_iter.reset()
                 else:
@@ -825,6 +856,152 @@ class BaseModule(object):
                             "rollback_epoch": st.epoch})
         guard.note_rollback(st.tag)
         return st
+
+    def _elastic_reform(self, err, ckpt_mgr, guard, eval_metric, epoch,
+                        nbatch, train_data=None):
+        """Worker-loss recovery (docs/robustness.md "Elastic distributed
+        training"): survivors seal a durable emergency checkpoint, re-form
+        the control-plane ring at N-1, adopt ONE authoritative state (the
+        leader's newest checkpoint — survivors can legitimately be one
+        step apart at the failure point), re-derive the gradient rescale
+        and this worker's data shard for the shrunken world, and hand
+        ``fit`` a resume cursor. Raises :class:`WorkerLostError` (with a
+        flight dump) when the re-form budget (``MXTPU_KV_MAX_REFORMS``)
+        is exhausted or the store has no elastic transport."""
+        from ..kvstore import WorkerLostError
+        from ..obs import flight as _flight
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or getattr(kv, "reform", None) is None \
+                or ckpt_mgr is None:
+            why = ("fit() has no checkpoint_prefix to recover through"
+                   if kv is not None and ckpt_mgr is None
+                   else "kvstore has no elastic re-form support")
+            _flight.dump("WorkerLostError: %s" % err, extra={"elastic": why})
+            raise err
+        max_reforms = int(getattr(kv, "max_reforms", 0))
+        if int(getattr(kv, "reforms", 0)) >= max_reforms:
+            _flight.dump("WorkerLostError: re-form budget exhausted",
+                         extra={"reforms": int(kv.reforms),
+                                "max_reforms": max_reforms,
+                                "liveness": kv.liveness_table()})
+            raise WorkerLostError(
+                "worker lost and the re-form budget is exhausted (%d "
+                "re-form(s) this run, MXTPU_KV_MAX_REFORMS=%d): %s"
+                % (kv.reforms, max_reforms, err)) from err
+        self.logger.warning(
+            "worker lost (%s): re-forming the ring (re-form %d/%d)",
+            err, int(kv.reforms) + 1, max_reforms)
+        # 1. seal this survivor's own durable emergency checkpoint BEFORE
+        # any further ring traffic: if the re-form itself fails, the run
+        # stays resumable from here (drain twice — an in-flight cadence
+        # save lands first, then the emergency save must be on disk)
+        ckpt_mgr.drain()
+        if guard is None or guard.ok_to_checkpoint():
+            ckpt_mgr.save(self, epoch, nbatch + 1, metric=eval_metric)
+        ckpt_mgr.drain()
+        # 2. re-form at N-1 (plus any joiners already waiting)
+        kv.reform()
+        # 3. one authoritative state for the new ring
+        st = self._adopt_leader_checkpoint(kv, ckpt_mgr)
+        self.init_params(initializer=None, arg_params=st.arg_params,
+                         aux_params=st.aux_params, allow_missing=False,
+                         force_init=True)
+        self._drop_fused_state()
+        # rescale/batch-size re-derivation MUST precede the optimizer
+        # state restore: set_optimizer builds a fresh (empty) kvstore
+        # updater, which _apply_resume_state then re-fills
+        self._refresh_dist_scale()
+        self._apply_resume_state(st)
+        self._reshard_train_data(kv, train_data)
+        _flight.dump(
+            "ring re-formed at %d worker(s), resuming from %s"
+            % (kv.num_workers, st.tag),
+            extra={"liveness": kv.liveness_table(),
+                   "reforms": int(kv.reforms), "resume_tag": st.tag,
+                   "resume_epoch": st.epoch,
+                   "batches_done": st.batches_done})
+        self.logger.warning(
+            "ring re-formed: %d worker(s) (this rank now index %d), "
+            "resuming from %s (epoch %d, %d batches done)",
+            kv.num_workers, kv.worker_index, st.tag, st.epoch,
+            st.batches_done)
+        return st
+
+    def _adopt_leader_checkpoint(self, kv, ckpt_mgr):
+        """Broadcast the leader's newest checkpoint BYTES over the ring
+        and install + load it on every member. Survivors may be one step
+        apart at the failure point; adopting one authoritative state is
+        what makes the re-formed replicas bitwise-identical — and a fresh
+        resume from the same prefix then reproduces exactly this state
+        (the invariant the elastic test pins)."""
+        payload = b""
+        if kv.worker_index == 0:
+            payload = ckpt_mgr.export_latest()
+        blob = kv.broadcast_bytes(payload)
+        if kv.worker_index != 0 and blob:
+            ckpt_mgr.import_blob(blob)
+        st = ckpt_mgr.load_latest()
+        if st is None:
+            raise MXNetError(
+                "ring re-form: no loadable checkpoint after the leader "
+                "broadcast (prefix %r)" % (ckpt_mgr.prefix,))
+        return st
+
+    def _reshard_train_data(self, kv, train_data):
+        """Re-derive this worker's data shard from its new (index, size)
+        after a membership change. Iterators expose ``reshard_workers``;
+        anything else keeps its original shard — correct but overlapping,
+        so the run says so."""
+        if train_data is None:
+            return
+        reshard = getattr(train_data, "reshard_workers", None)
+        if reshard is not None:
+            reshard(kv.worker_index, kv.num_workers)
+        else:
+            self.logger.warning(
+                "train_data has no reshard_workers(index, size): keeping "
+                "the pre-reform shard (the dead worker's shard is not "
+                "redistributed this run)")
+
+    def _admit_dist_joiners(self, ckpt_mgr, train_data):
+        """Epoch-boundary admission (docs/robustness.md "Elastic
+        distributed training"): when a late worker has published a join
+        request, re-form the ring to include it and broadcast the
+        leader's epoch-boundary checkpoint as its warm start; incumbents
+        re-derive shards and rescale exactly like a loss re-form. The
+        decision itself rides a leader broadcast so every incumbent
+        reaches the SAME verdict — per-member polling could split on a
+        request that lands mid-poll."""
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or "dist" not in getattr(kv, "type", ""):
+            return
+        poll = getattr(kv, "pending_joiners", None)
+        bcast = getattr(kv, "broadcast_bytes", None)
+        if poll is None or bcast is None or ckpt_mgr is None \
+                or kv.num_workers <= 0:
+            return
+        import pickle
+        payload = b""
+        if kv.worker_index == 0:
+            payload = pickle.dumps(sorted(poll()))
+        blob = bcast(payload)
+        if not blob:
+            return  # no elastic transport: broadcast_bytes is identity
+        pending = pickle.loads(blob)
+        if not pending:
+            return
+        self.logger.info("admitting joining worker(s) %s at the epoch "
+                         "boundary", list(pending))
+        kv.reform()
+        self._adopt_leader_checkpoint(kv, ckpt_mgr)
+        self._drop_fused_state()
+        self._refresh_dist_scale()
+        self._reshard_train_data(kv, train_data)
+
+    def _refresh_dist_scale(self):
+        """Hook: re-derive the gradient rescale (1 / global batch) after
+        a dist membership change. Subclasses with an optimizer
+        override."""
 
     def _drop_fused_state(self):
         """Hook: discard (not flush) any fused device state so the next

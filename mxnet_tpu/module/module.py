@@ -451,6 +451,29 @@ class Module(BaseModule):
         self._fused_dirty = False
         self._fused_params_stale = False
 
+    def _refresh_dist_scale(self):
+        """Post-re-form hook (docs/robustness.md "Elastic distributed
+        training"): the live worker count changed, so the global-batch
+        denominator behind ``rescale_grad`` changed with it. Re-derive
+        the scale into every optimizer copy (the kvstore's pickled one
+        included) and drop the fused TrainStep — its trace captured the
+        old scale. MUST run BEFORE checkpoint states are re-applied:
+        ``set_optimizer`` builds a fresh (empty) kvstore updater."""
+        kv = self._kvstore
+        if kv is None or "dist" not in kv.type:
+            return
+        bs = self._exec_group.batch_size * max(1, kv.num_workers)
+        rescale = 1.0 / bs
+        if self._optimizer is not None:
+            self._optimizer.rescale_grad = rescale
+        upd_opt = getattr(self._resolve_updater(), "optimizer", None)
+        if upd_opt is not None and upd_opt is not self._optimizer:
+            upd_opt.rescale_grad = rescale
+        if self._update_on_kvstore and self._optimizer is not None:
+            kv.set_optimizer(self._optimizer)
+        self._fused = None
+        self._drop_fused_state()
+
     def _scale_lr(self, factor):
         """Divergence-rollback hook: reduce the learning rate by ``factor``
         everywhere the next step might read it — the optimizer, its
@@ -578,11 +601,14 @@ class Module(BaseModule):
                     "kvstore — the multi-axis mesh is single-controller; "
                     "use the global 'data' mesh for dist workers")
             mesh = om
-        elif self._is_dist_kvstore():
-            # dist_sync INSIDE the fused step: the batch shards over a
-            # global mesh spanning every worker process and XLA places the
-            # gradient psum over DCN/ICI exactly where the reference ran
-            # ps-lite push/pull (ref: kvstore_dist.h sync mode)
+        elif (self._is_dist_kvstore()
+              and getattr(self._kvstore, "_ring", None) is None):
+            # LEGACY mesh transport (MXTPU_DIST_TRANSPORT=mesh): the batch
+            # shards over a global mesh spanning every worker process and
+            # XLA places the gradient psum over DCN/ICI exactly where the
+            # reference ran ps-lite push/pull (ref: kvstore_dist.h sync
+            # mode). Not elastic — the default ring transport keeps the
+            # mesh LOCAL and sums gradients through the control plane.
             from ..parallel.mesh import global_data_mesh
             mesh = global_data_mesh(
                 local_devices=[c.to_device() for c in self._context])
@@ -591,6 +617,14 @@ class Module(BaseModule):
             label_names=eg.label_names, optimizer=self._optimizer,
             mesh=mesh, param_shardings=self._param_shardings or None,
             frozen_param_names=frozen)
+        if (self._is_dist_kvstore()
+                and getattr(self._kvstore, "_ring", None) is not None):
+            # ring transport: each process runs the LOCAL program; the
+            # cross-process gradient sum is the in-scan host callback.
+            # Donation off — a dispatch killed by WorkerLostError must
+            # leave the pre-step state buffers valid for the re-form.
+            self._fused.dist_reduce = self._kvstore.grad_reduce
+            self._fused.donate = False
         self._fused_state = self._seed_fused_state()
         self._fused_params_stale = False
         self._fused_metrics_ok = self._infer_fused_metrics_ok()
@@ -654,9 +688,11 @@ class Module(BaseModule):
             return (False, "module configuration needs the per-step "
                     "executor path (monitor/grad_req/unfused optimizer/"
                     "shared module)")
-        if self._is_dist_kvstore():
-            return (False, "dist kvstore keeps per-step dispatch "
-                    "(per-step push/pull sync is the contract)")
+        if (self._is_dist_kvstore()
+                and getattr(self._kvstore, "_ring", None) is None):
+            return (False, "dist kvstore ('mesh' transport) keeps per-step "
+                    "dispatch (per-step push/pull sync is the contract); "
+                    "the default ring transport bulk-dispatches")
         if eval_metric is None:
             if not self._infer_fused_metrics_ok():
                 return (False, "the default device metric sums need a "

@@ -334,7 +334,7 @@ _default_views_done = False
 
 
 def register_default_views(registry=None):
-    """Register the five legacy process-global counter objects as views.
+    """Register the process-global counter objects as views.
 
     Imports lazily (obs must stay importable before io/guard/serving) and
     is idempotent. Called from ``mxnet_tpu.obs`` import; safe to call
@@ -366,11 +366,16 @@ def register_default_views(registry=None):
         from .. import tracecheck as _tc
         return {"count": _tc.retrace_count()}
 
+    def dist_health():
+        from .. import dist_ring as _dr
+        return _dr.DIST_HEALTH.report()
+
     reg.register_view("data_health", data_health)
     reg.register_view("training_health", training_health)
     reg.register_view("serving_health", serving_health)
     reg.register_view("pipeline_stats", pipeline_stats)
     reg.register_view("retrace_events", retrace_events)
+    reg.register_view("dist_health", dist_health)
     if registry is None:
         _default_views_done = True
     return reg

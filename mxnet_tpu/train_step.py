@@ -366,6 +366,15 @@ class TrainStep(object):
         # logs (or raises, MXTPU_TRACECHECK=error) the cache-key diff
         self._watcher = None
         self.health = None  # per-run TrainingHealth (Module attaches it)
+        # elastic dist training (docs/robustness.md): Module attaches the
+        # kvstore's ring reducer here; the step then sums gradients across
+        # worker processes through an ordered host callback INSIDE the
+        # compiled program (so the K-step scan keeps its bulk dispatch).
+        # Donation is disabled in dist mode: a dispatch that dies in the
+        # ring must leave the input state buffers valid for the re-form.
+        self.dist_reduce = None
+        self.dist_error = None
+        self.donate = True
 
     # ------------------------------------------------------------------
     def _ambient(self):
@@ -648,6 +657,12 @@ class TrainStep(object):
                 # through the guarded trace so faulted and unfaulted guarded
                 # runs share ONE compiled program
                 gs = {n: g + poison.astype(g.dtype) for n, g in gs.items()}
+            if self.dist_reduce is not None:
+                # cross-process sum AFTER the local poison (a poisoned
+                # worker poisons every replica, so guarded skips stay
+                # bitwise-identical) and BEFORE gnorm/clip/guard, which
+                # must see the GLOBAL gradient
+                gs = self._cross_grad_reduce(gs, updated)
             gnorm = None
             if guard or clip_norm is not None:
                 gnorm = jnp.sqrt(sum(
@@ -709,6 +724,65 @@ class TrainStep(object):
 
         return step_fn
 
+    def _cross_grad_reduce(self, gs, updated):
+        """Sum the update set's gradients across worker processes inside
+        the traced step: flatten to ONE f32 vector, hop to the host
+        through an ordered ``io_callback`` for the control-plane ring
+        allreduce, unflatten. One callback per step regardless of
+        parameter count, and it composes with the K-step ``lax.scan`` —
+        the bulked dispatch makes K ring exchanges without returning to
+        Python. A lost worker cannot raise through XLA: the callback
+        stashes the error on the TrainStep, returns NaN (a guarded step
+        no-ops on it), and :meth:`_dist_sync_result` re-raises after the
+        dispatch."""
+        from jax.experimental import io_callback
+        names = list(updated)
+        if not names:
+            return gs
+        flat = jnp.concatenate([gs[n].astype(jnp.float32).reshape(-1)
+                                for n in names])
+
+        def host_sum(v):
+            try:
+                out = self.dist_reduce(np.asarray(v, np.float32))
+                return np.asarray(out, np.float32).reshape(v.shape)
+            except Exception as e:
+                self.dist_error = e
+                return np.full(v.shape, np.nan, np.float32)
+
+        sds = jax.ShapeDtypeStruct(flat.shape, jnp.float32)
+        kwargs = {}
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            # pin the callback to one device so a multi-device local mesh
+            # performs ONE ring exchange per step, not one per device
+            kwargs["sharding"] = jax.sharding.SingleDeviceSharding(
+                self.mesh.devices.ravel()[0])
+        try:
+            red = io_callback(host_sum, sds, flat, ordered=True, **kwargs)
+        except TypeError:           # older jax: no sharding kwarg
+            red = io_callback(host_sum, sds, flat, ordered=True)
+        out = {}
+        off = 0
+        for n in names:
+            size = int(np.prod(gs[n].shape)) if gs[n].shape else 1
+            out[n] = (red[off:off + size].reshape(gs[n].shape)
+                      .astype(gs[n].dtype))
+            off += size
+        return out
+
+    def _dist_sync_result(self, out):
+        """Dist-mode dispatch epilogue: block on the results and re-raise
+        any error the ring callback stashed (WorkerLostError surfaces
+        HERE, with the pre-dispatch state still intact — donation is off
+        in dist mode). Single-process: identity, no block."""
+        if self.dist_reduce is None:
+            return out
+        jax.block_until_ready(out)
+        err, self.dist_error = self.dist_error, None
+        if err is not None:
+            raise err
+        return out
+
     def _pin_state_sharding(self, state):
         """Constrain the OUTPUT state to the same shardings ``_shard_state``
         placed the input with. Without the pin, GSPMD is free to return the
@@ -766,7 +840,8 @@ class TrainStep(object):
         outs = None
         if state is not None and self.mesh is not None:
             outs = (self._state_out_shardings(state), None)
-        return jax.jit(self._make_step_fn(batch_size), donate_argnums=(0,),
+        return jax.jit(self._make_step_fn(batch_size),
+                       donate_argnums=(0,) if self.donate else (),
                        out_shardings=outs)
 
     def _build_guard_step(self, batch_size, state=None):
@@ -794,7 +869,8 @@ class TrainStep(object):
         outs_sh = None
         if state is not None and self.mesh is not None:
             outs_sh = (self._state_out_shardings(state), None, None)
-        return jax.jit(fn, donate_argnums=(0,), out_shardings=outs_sh)
+        return jax.jit(fn, donate_argnums=(0,) if self.donate else (),
+                       out_shardings=outs_sh)
 
     def _build_scan(self, batch_size, k, guard=False, metric_spec=None,
                     state=None):
@@ -879,7 +955,8 @@ class TrainStep(object):
         outs_sh = None
         if state is not None and self.mesh is not None:
             outs_sh = (self._state_out_shardings(state), None)
-        return jax.jit(scan_fn, donate_argnums=(0,), out_shardings=outs_sh)
+        return jax.jit(scan_fn, donate_argnums=(0,) if self.donate else (),
+                       out_shardings=outs_sh)
 
     def _dispatch_key(self):
         if self._needs_rng or getattr(self._opt, "fused_needs_key", False):
@@ -1006,7 +1083,7 @@ class TrainStep(object):
                          jnp.asarray(np.asarray(
                              self._poison_scalars(1)[0], np.float32)))
             with self._ambient():
-                out = fn(*call_args)
+                out = self._dist_sync_result(fn(*call_args))
                 self._tc_after("guard-step", bs, fn, call_args, result=out)
             return out
         if bs not in self._jit:
@@ -1015,7 +1092,7 @@ class TrainStep(object):
         call_args = (state, batch, self._dispatch_key(),
                      jnp.asarray(np.asarray(self._next_lr(), np.float32)))
         with self._ambient():
-            out = fn(*call_args)
+            out = self._dist_sync_result(fn(*call_args))
             self._tc_after("step", bs, fn, call_args, result=out)
         return out
 
@@ -1084,14 +1161,14 @@ class TrainStep(object):
             call_args = (state, superbatch, self._dispatch_key(), lrs,
                          jnp.asarray(self._poison_scalars(k)))
             with self._ambient():
-                new_state, packed = fn(*call_args)
+                new_state, packed = self._dist_sync_result(fn(*call_args))
                 sums = StepMetrics(packed, guarded=True, spec=metric_spec)
                 self._tc_after("guard-scan", ckey, fn, call_args,
                                result=(new_state, sums), spec=metric_spec)
             return new_state, sums
         call_args = (state, superbatch, self._dispatch_key(), lrs)
         with self._ambient():
-            new_state, packed = fn(*call_args)
+            new_state, packed = self._dist_sync_result(fn(*call_args))
             sums = StepMetrics(packed, spec=metric_spec)
             self._tc_after("scan", ckey, fn, call_args,
                            result=(new_state, sums), spec=metric_spec)

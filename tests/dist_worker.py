@@ -46,9 +46,47 @@ def main():
         os._exit(0)
     elif mode == "resume":
         run_resume(mx, rank, nproc)
+    elif mode == "elastic":
+        run_elastic(mx, rank, nproc)
+        # same as deadworker: one peer is gone, the orderly shutdown
+        # barrier would hang
+        print("RANK-%d-PASS" % rank, flush=True)
+        os._exit(0)
     else:
         raise SystemExit("unknown mode %r" % mode)
     print("RANK-%d-PASS" % rank, flush=True)
+
+
+def _survivor_sync(rank, nproc, victim, tag):
+    """Completion sync over the raw coordination KV for tests that lose a
+    worker: rank 0 hosts the coordination service, so it must exit LAST —
+    otherwise a survivor still polling the plane aborts on
+    connection-reset before its PASS line (jax's distributed client
+    treats coordination-service loss as fatal). The ring barrier is no
+    use here: it would wait on the dead victim."""
+    import time
+
+    from jax._src.distributed import global_state
+    c = global_state.client
+    try:
+        # "ok", not "1": sub-2-byte values segfault jaxlib's dir-get
+        c.key_value_set("%s_done/%d" % (tag, rank), "ok",
+                        allow_overwrite=True)
+    except Exception:
+        return
+    if rank != 0:
+        return
+    want = ["%s_done/%d" % (tag, r) for r in range(nproc) if r != victim]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            got = c.key_value_dir_get("%s_done/" % tag)
+        except Exception:
+            return
+        items = dict(got.items() if hasattr(got, "items") else got)
+        if all(k in items for k in want):
+            return
+        time.sleep(0.2)
 
 
 def run_kvstore(mx, rank, nproc):
@@ -100,9 +138,17 @@ def run_kvstore(mx, rank, nproc):
     kv.barrier()                 # all ranks published their first beat
     assert kv.num_dead_node(0, timeout_sec=60) == 0, \
         "healthy cluster reported dead nodes"
-    # a rank that never existed counts dead against a tight horizon
+    # a rank that never existed counts dead against a tight horizon —
+    # with no startup grace: the phantom never published a beat, so the
+    # grace window is the only thing that could excuse it
     hb = kv._heartbeat
-    assert hb is not None and hb.dead_nodes(nproc + 1, timeout_sec=60) >= 1
+    assert hb is not None
+    grace = hb.startup_grace
+    hb.startup_grace = 0.0
+    try:
+        assert hb.dead_nodes(nproc + 1, timeout_sec=60) >= 1
+    finally:
+        hb.startup_grace = grace
 
     kv.barrier()
 
@@ -137,6 +183,7 @@ def run_deadworker(mx, rank, nproc):
             break
         time.sleep(1)
     assert dead >= 1, "rank %d never detected the killed worker" % rank
+    _survivor_sync(rank, nproc, victim, "deadworker")
 
 
 def run_resume(mx, rank, nproc):
@@ -201,6 +248,131 @@ def run_resume(mx, rank, nproc):
                                err_msg="resumed replicas diverged")
 
 
+def run_elastic(mx, rank, nproc):
+    """Worker-loss survival end to end (docs/robustness.md "Elastic
+    distributed training"): the highest rank SIGKILLs itself mid-epoch
+    via the kv.worker_die fault site; survivors must take an emergency
+    checkpoint, re-form the ring at N-1, re-shard the data, finish
+    training to accuracy — and a fresh resume from the same prefix must
+    be bitwise-identical to the live post-reform parameters."""
+    import glob
+
+    from mxnet_tpu import faults
+    from mxnet_tpu.io import NDArrayIter
+
+    n_class, dim, n_per = 8, 32, 192
+    num_epoch, batch_size = 8, 64
+    rng = np.random.RandomState(7)  # same on all ranks
+    templates = rng.randn(n_class, dim).astype(np.float32) * 3
+    labels_all = np.arange(n_class * n_per) % n_class
+    x_all = (templates[labels_all]
+             + rng.randn(len(labels_all), dim).astype(np.float32) * 0.5)
+
+    class ElasticIter(NDArrayIter):
+        """fit's re-shard hook: re-cut this worker's shard from the FULL
+        dataset at the post-reform (index, size)."""
+
+        def reshard_workers(self, part_index, num_parts):
+            ElasticIter.__init__(
+                self, x_all[part_index::num_parts],
+                labels_all[part_index::num_parts].astype(np.float32),
+                batch_size=batch_size, shuffle=False)
+
+    def net():
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+        h = mx.sym.Activation(h, name="relu1", act_type="relu")
+        h = mx.sym.FullyConnected(h, name="fc2", num_hidden=n_class)
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    # per-rank prefix dirs: the leader's checkpoint blob is imported
+    # under the LEADER's file names, which must not collide with this
+    # rank's own pre-reform saves
+    prefix = os.path.join(os.environ.get("MXTPU_TEST_TMPDIR", "/tmp"),
+                          "r%d" % rank, "elastic")
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9}
+
+    # rank 0 hosts the coordination service, so the victim is the LAST
+    # rank. Ring op #30 = 4 init broadcasts + 26 train-step allreduces =
+    # mid-epoch 3 (8 steps/epoch on a 512-sample shard) — the kill lands
+    # between checkpointable batch boundaries
+    victim = nproc - 1
+    if rank == victim:
+        faults.inject("kv.worker_die", nth=30, kind="die")
+
+    import time
+
+    mod = mx.mod.Module(net())
+    train = ElasticIter(x_all[rank::nproc],
+                        labels_all[rank::nproc].astype(np.float32),
+                        batch_size=batch_size, shuffle=False)
+    t0 = time.time()
+    mod.fit(train, num_epoch=num_epoch, kvstore="dist_sync",
+            optimizer="sgd", optimizer_params=opt_params,
+            initializer=mx.initializer.Xavier(),
+            checkpoint_prefix=prefix, checkpoint_keep=50)
+    fit_s = time.time() - t0
+    assert rank != victim, "victim outlived its SIGKILL"
+
+    # survivors: exactly one re-form, membership shrank to N-1
+    kv = mod._kvstore
+    assert kv is not None and kv.reforms == 1, \
+        "rank %d: expected 1 ring re-form, saw %r" % (rank, kv.reforms)
+    assert kv.num_workers == nproc - 1, \
+        "rank %d: ring did not shrink to %d" % (rank, nproc - 1)
+
+    # the mid-kill emergency checkpoint is durably on disk (b > 0: only
+    # the emergency path saves mid-epoch in this run)
+    mids = [f for f in glob.glob(prefix + "-e*-b*.params")
+            if not f.endswith("-b00000000.params")]
+    assert mids, "rank %d: no mid-epoch emergency checkpoint" % rank
+
+    # training finished to accuracy despite losing a worker mid-run
+    score = mod.score(NDArrayIter(x_all, labels_all.astype(np.float32),
+                                  batch_size=batch_size), "acc")
+    acc = dict(score)["accuracy"]
+    assert acc >= 0.90, "rank %d accuracy %.3f < 0.90" % (rank, acc)
+
+    # survivors' replicas agree bitwise-identically: the fresh store sees
+    # the RE-FORMED shared ring, so the sum spans nproc-1 members
+    arg_live, _ = mod.get_params()
+    blob = np.concatenate([arg_live[k].asnumpy().ravel()
+                           for k in sorted(arg_live)])
+    kvc = mx.kv.create("dist_sync")
+    assert kvc.num_workers == nproc - 1
+    tot = mx.nd.zeros(blob.shape)
+    kvc.init("elasticcheck", tot)
+    kvc.push("elasticcheck", mx.nd.array(blob))
+    kvc.pull("elasticcheck", out=tot)
+    np.testing.assert_allclose(tot.asnumpy(), (nproc - 1) * blob,
+                               rtol=1e-6,
+                               err_msg="survivor replicas diverged")
+
+    # a FRESH module resuming from the prefix reproduces the live
+    # post-reform state bitwise (resume='auto' lands on the final
+    # epoch-end tag, so the epoch loop is already complete)
+    mod2 = mx.mod.Module(net())
+    train.reset()
+    mod2.fit(train, num_epoch=num_epoch, kvstore="dist_sync",
+             optimizer="sgd", optimizer_params=opt_params,
+             initializer=mx.initializer.Xavier(),
+             checkpoint_prefix=prefix, resume="auto")
+    arg_res, _ = mod2.get_params()
+    for name in sorted(arg_live):
+        assert (arg_res[name].asnumpy().tobytes()
+                == arg_live[name].asnumpy().tobytes()), \
+            "rank %d: resumed %r differs from live state" % (rank, name)
+
+    # machine-readable line for tools/dist_gate.py: collective wall time
+    # + post-reform membership (the dataset is partitioned, so aggregate
+    # throughput = num_epoch * full dataset / max survivor fit_s)
+    print("RANK-%d-ELASTIC-STATS fit_s=%.3f epochs=%d samples=%d "
+          "reforms=%d workers=%d"
+          % (rank, fit_s, num_epoch, len(x_all), kv.reforms,
+             kv.num_workers), flush=True)
+    _survivor_sync(rank, nproc, victim, "elastic")
+
+
 def run_lenet(mx, rank, nproc):
     """Distributed training to accuracy (ref: dist_lenet.py / test_mlp)."""
     from mxnet_tpu.io import NDArrayIter
@@ -230,11 +402,11 @@ def run_lenet(mx, rank, nproc):
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
             initializer=mx.initializer.Xavier())
 
-    # the dist bail-out is gone: fit must have used the fused in-step-psum
-    # path over the global mesh
+    # the dist bail-out is gone: fit must have used the fused path with
+    # the cross-worker gradient reduction wired into every dispatch
     assert mod._fused is not None, "dist fit fell back to the slow path"
-    from mxnet_tpu.parallel.mesh import is_multiprocess
-    assert is_multiprocess(mod._fused.mesh), "fused step not multi-host"
+    assert mod._fused.dist_reduce is not None, \
+        "fused step not wired to the cross-worker reduction"
 
     score = mod.score(NDArrayIter(x, y, batch_size=64), "acc")
     acc = dict(score)["accuracy"]

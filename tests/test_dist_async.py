@@ -1,0 +1,155 @@
+"""Bounded-staleness dist_async (docs/robustness.md "Elastic distributed
+training"). The SSP contract under test: push never blocks; pull blocks
+ONLY while this worker is more than S versions ahead of the slowest live
+peer, proceeds at lag <= S, drops dead laggards from the window, and a
+persistent stall ends in KVStoreTimeoutError — never a hang. Workers are
+threads over the in-memory LocalClient plane (``_plane`` injection); no
+test sleeps its way to a verdict.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.dist_ring import DIST_HEALTH, LocalClient
+from mxnet_tpu.kvstore import KVStoreDistAsync, create
+
+
+def _pair(size=2, staleness=1, timeout=30.0):
+    c = LocalClient()
+    kvs = [KVStoreDistAsync(_plane=(c, r, size)) for r in range(size)]
+    for kv in kvs:
+        kv.staleness = staleness
+        kv._poll = 0.0
+        kv._pull_timeout = timeout
+    return c, kvs
+
+
+def _val(kv, k=3, shape=(4,)):
+    out = nd.zeros(shape)
+    kv.pull(k, out=out)
+    return np.asarray(out.data)
+
+
+def test_create_returns_async_store():
+    kv = create("dist_async")
+    assert isinstance(kv, KVStoreDistAsync)
+    assert kv.type == "dist_async"
+    # single process: the store is fully local, no plane required
+    kv.init(3, nd.ones((2,)))
+    kv.push(3, nd.ones((2,)) * 4)
+    out = nd.zeros((2,))
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(np.asarray(out.data), np.full(2, 4.0))
+
+
+def test_rank0_init_is_authoritative():
+    c, (kv0, kv1) = _pair()
+    kv0.init(3, nd.ones((4,)) * 7)       # rank 0 publishes
+    kv1.init(3, nd.zeros((4,)))          # rank 1 adopts rank 0's value
+    np.testing.assert_array_equal(_val(kv1), np.full(4, 7.0))
+
+
+def test_no_updater_sum_of_latest_pushes():
+    c, (kv0, kv1) = _pair(staleness=4)
+    kv0.init(3, nd.zeros((4,)))
+    kv1.init(3, nd.zeros((4,)))
+    kv0.push(3, nd.ones((4,)) * 1)
+    kv1.push(3, nd.ones((4,)) * 2)
+    # the dist_sync closed form when everyone pushed the same number of
+    # times: sum of each worker's latest push
+    np.testing.assert_array_equal(_val(kv0), np.full(4, 3.0))
+    np.testing.assert_array_equal(_val(kv1), np.full(4, 3.0))
+    # a second round overwrites in place, never doubles
+    kv0.push(3, nd.ones((4,)) * 10)
+    kv1.push(3, nd.ones((4,)) * 20)
+    np.testing.assert_array_equal(_val(kv0), np.full(4, 30.0))
+
+
+def test_updater_applies_each_contribution_exactly_once():
+    c, (kv0, kv1) = _pair(staleness=8)
+    for kv in (kv0, kv1):
+        kv.init(3, nd.zeros((4,)))
+        kv._set_updater(lambda k, g, s: s._set_data(s.data + g.data))
+    kv0.push(3, nd.ones((4,)))
+    kv0.push(3, nd.ones((4,)))
+    kv1.push(3, nd.ones((4,)) * 5)
+    # delta = visible cumulative total - already applied: repeated pulls
+    # are idempotent, interleaved pulls never double-count
+    np.testing.assert_array_equal(_val(kv0), np.full(4, 7.0))
+    np.testing.assert_array_equal(_val(kv0), np.full(4, 7.0))
+    np.testing.assert_array_equal(_val(kv1), np.full(4, 7.0))
+    kv1.push(3, nd.ones((4,)))
+    np.testing.assert_array_equal(_val(kv1), np.full(4, 8.0))
+    np.testing.assert_array_equal(_val(kv0), np.full(4, 8.0))
+
+
+# -- the staleness window ----------------------------------------------------
+
+def test_pull_proceeds_at_lag_within_window():
+    c, (kv0, kv1) = _pair(staleness=2)
+    kv0.init(3, nd.zeros((4,)))
+    kv1.init(3, nd.zeros((4,)))
+    kv0.push(3, nd.ones((4,)))
+    kv0.push(3, nd.ones((4,)))   # 2 ahead of rank 1 == S: allowed
+    np.testing.assert_array_equal(_val(kv0), np.full(4, 1.0))
+    assert kv0.staleness_lag == 2
+    assert DIST_HEALTH.staleness_lag == 2
+
+
+def test_pull_blocks_past_window_and_times_out():
+    c, (kv0, kv1) = _pair(staleness=1, timeout=0.05)
+    kv0.init(3, nd.zeros((4,)))
+    kv1.init(3, nd.zeros((4,)))
+    kv0.push(3, nd.ones((4,)))
+    kv0.push(3, nd.ones((4,)))   # 2 ahead, S=1: pull must gate
+    out = nd.zeros((4,))
+    # a started-but-stuck pull escalates through _robust as MXNetError
+    # (never retried: the op already started) — the window is named
+    with pytest.raises(MXNetError) as ei:
+        kv0.pull(3, out=out)
+    assert "window S=1" in str(ei.value)
+
+
+def test_blocked_pull_unblocks_when_laggard_pushes():
+    c, (kv0, kv1) = _pair(staleness=1, timeout=30.0)
+    kv0.init(3, nd.zeros((4,)))
+    kv1.init(3, nd.zeros((4,)))
+    kv0.push(3, nd.ones((4,)))
+    kv0.push(3, nd.ones((4,)))   # 2 ahead: the pull below gates...
+
+    t = threading.Thread(
+        target=lambda: kv1.push(3, nd.ones((4,)) * 3), daemon=True)
+    t.start()                    # ...until the laggard's push lands
+    got = _val(kv0)
+    t.join(30)
+    np.testing.assert_array_equal(got, np.full(4, 4.0))
+    assert kv0.staleness_lag <= 1
+
+
+def test_dead_laggard_is_dropped_from_window():
+    c, (kv0, kv1) = _pair(staleness=1, timeout=30.0)
+    kv0.init(3, nd.zeros((4,)))
+    kv1.init(3, nd.zeros((4,)))
+    kv1.push(3, nd.ones((4,)) * 9)
+    kv0.push(3, nd.ones((4,)))
+    kv0.push(3, nd.ones((4,)))
+    kv0.push(3, nd.ones((4,)))   # 3 ahead of rank 1, S=1
+    c.mark_dead(1)
+    # async tolerates loss: the dead laggard stops gating, its LANDED
+    # contribution stays in the aggregate
+    np.testing.assert_array_equal(_val(kv0), np.full(4, 10.0))
+    assert kv0.num_workers == 1
+    assert kv0.num_dead_node(0) == 1
+
+
+def test_push_never_blocks_on_stale_peers():
+    c, (kv0, kv1) = _pair(staleness=0, timeout=0.05)
+    kv0.init(3, nd.zeros((4,)))
+    kv1.init(3, nd.zeros((4,)))
+    for _ in range(5):           # far past any window: still instant
+        kv0.push(3, nd.ones((4,)))
+    assert kv0._ver[3] == 5

@@ -1,0 +1,227 @@
+"""Elastic control-plane ring, tier-1 (docs/robustness.md "Elastic
+distributed training"). Threads stand in for worker processes over the
+in-memory LocalClient plane; liveness is explicit (mark_dead), polling
+interval is zero, and every fault fires at an exact call count — so no
+test ever sleeps its way to a verdict and no failure mode can hang.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import faults
+from mxnet_tpu.dist_ring import DIST_HEALTH, LocalClient, Ring
+from mxnet_tpu.kvstore import KVStoreTimeoutError, WorkerLostError
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    DIST_HEALTH.reset()
+    yield
+    faults.clear()
+    DIST_HEALTH.reset()
+
+
+def _rings(client, members, **kw):
+    kw.setdefault("poll", 0.0)
+    kw.setdefault("op_timeout", 30.0)
+    return {r: Ring(client, r, members, **kw) for r in members}
+
+
+def _run(fns):
+    """Run one callable per worker on its own thread; re-raise the first
+    failure (never swallow a worker's assertion)."""
+    out, errs = {}, []
+
+    def wrap(r, fn):
+        try:
+            out[r] = fn()
+        except BaseException as e:  # noqa: BLE001 - reported to the test
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=wrap, args=(r, fn), daemon=True)
+          for r, fn in fns.items()]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+        assert not t.is_alive(), "ring op hung (the one thing it must not)"
+    if errs:
+        raise errs[0][1]
+    return out
+
+
+# -- collectives -------------------------------------------------------------
+
+def test_allreduce_sum_bitwise_identical():
+    c = LocalClient()
+    rings = _rings(c, [0, 1, 2])
+    vals = {r: np.arange(6, dtype=np.float32).reshape(2, 3) * (r + 1)
+            for r in rings}
+    out = _run({r: (lambda rr=r: rings[rr].allreduce_sum(vals[rr]))
+                for r in rings})
+    want = vals[0] + vals[1] + vals[2]
+    for r in rings:
+        # bitwise: every member sums in member order, not arrival order
+        assert out[r].tobytes() == want.tobytes()
+
+
+def test_broadcast_and_barrier():
+    c = LocalClient()
+    rings = _rings(c, [0, 1])
+    payload = np.array([3.5, -1.0])
+    out = _run({0: lambda: rings[0].broadcast(payload),
+                1: lambda: rings[1].broadcast(None)})
+    np.testing.assert_array_equal(out[0], payload)
+    np.testing.assert_array_equal(out[1], payload)
+    out = _run({0: lambda: rings[0].broadcast_bytes(b"ckpt-blob"),
+                1: lambda: rings[1].broadcast_bytes(b"")})
+    assert out == {0: b"ckpt-blob", 1: b"ckpt-blob"}
+    _run({r: rings[r].barrier for r in rings})  # completes, no error
+
+
+def test_single_member_short_circuits():
+    c = LocalClient()
+    ring = Ring(c, 0, [0], poll=0.0)
+    np.testing.assert_array_equal(ring.allreduce_sum(np.ones(3)), np.ones(3))
+    assert ring.broadcast_bytes(b"x") == b"x"
+    ring.barrier()
+    assert c.dir("") == {}  # no control-plane traffic at size 1
+
+
+# -- worker loss -------------------------------------------------------------
+
+def test_dead_peer_raises_worker_lost_not_hang():
+    c = LocalClient()
+    rings = _rings(c, [0, 1, 2])
+    c.mark_dead(2)  # rank 2 never shows up for the op
+
+    def survivor(r):
+        with pytest.raises(WorkerLostError) as ei:
+            rings[r].allreduce_sum(np.ones(2))
+        assert "2" in str(ei.value)
+        return True
+
+    out = _run({0: lambda: survivor(0), 1: lambda: survivor(1)})
+    assert out == {0: True, 1: True}
+    assert DIST_HEALTH.worker_lost >= 2
+    assert rings[0].dead == (2,)
+    assert rings[0].liveness_table()["2"] == "dead"
+
+
+def test_reform_drops_dead_member_and_ring_works_again():
+    c = LocalClient()
+    rings = _rings(c, [0, 1, 2])
+    c.mark_dead(2)
+    _run({0: lambda: pytest.raises(WorkerLostError,
+                                   rings[0].allreduce_sum, np.ones(1)),
+          1: lambda: pytest.raises(WorkerLostError,
+                                   rings[1].allreduce_sum, np.ones(1))})
+    out = _run({0: rings[0].reform, 1: rings[1].reform})
+    assert out[0] == out[1] == [0, 1]
+    assert rings[0].gen == rings[1].gen == 1
+    assert rings[1].index == 1  # logical placement re-derived
+    assert DIST_HEALTH.reforms >= 1
+    # the re-formed ring is fully functional
+    out = _run({r: (lambda rr=r: rings[rr].allreduce_sum(
+        np.full(2, float(rr + 1)))) for r in (0, 1)})
+    np.testing.assert_array_equal(out[0], np.full(2, 3.0))
+    np.testing.assert_array_equal(out[1], np.full(2, 3.0))
+
+
+def test_pending_reform_aborts_waiters():
+    """A survivor blocked in a fetch must abort to the re-form the moment
+    any peer proposes one — not wait out its own op timeout."""
+    c = LocalClient()
+    rings = _rings(c, [0, 1], op_timeout=30.0)
+    # rank 1 proposed generation 2's re-form (as if it already detected a
+    # loss); rank 0 sits down to a normal op and must bail immediately
+    c.set("mxring/reform/1/prop/0", '{"members": [0], "joiners": []}')
+    with pytest.raises(WorkerLostError) as ei:
+        rings[0].allreduce_sum(np.ones(1))
+    assert "already proposed" in str(ei.value)
+    assert rings[1] is not None  # rank 1 never even ran — no hang either
+
+
+def test_evicted_rank_raises():
+    c = LocalClient()
+    ring = Ring(c, 1, [0, 1], poll=0.0, op_timeout=30.0)
+    # the survivors' proposal for gen 1 excludes rank 1
+    c.set("mxring/reform/1/prop/0", '{"members": [0], "joiners": []}')
+    with pytest.raises(WorkerLostError) as ei:
+        ring.reform()
+    assert "evicted" in str(ei.value)
+
+
+# -- join (late worker) ------------------------------------------------------
+
+def test_join_at_reform_admits_new_member():
+    c = LocalClient()
+    rings = _rings(c, [0, 1])
+    joiner = Ring(c, 2, [2], ns="mxring", poll=0.0, op_timeout=30.0)
+
+    # the admission contract is epoch-boundary: incumbents re-form only
+    # AFTER seeing the pending request (fit's _admit_dist_joiners), so
+    # the request is on the plane before anyone proposes
+    c.set("mxring/join/2", b"1")
+    assert rings[0].poll_joiners() == [2]
+    out = _run({0: rings[0].reform, 1: rings[1].reform,
+                2: lambda: joiner.request_join(timeout=30.0)})
+    assert out[0] == out[1] == out[2] == [0, 1, 2]
+    assert joiner.gen == rings[0].gen == 1
+    # all three exchange on the new generation
+    res = _run({0: lambda: rings[0].allreduce_sum(np.ones(1)),
+                1: lambda: rings[1].allreduce_sum(np.ones(1)),
+                2: lambda: joiner.allreduce_sum(np.ones(1))})
+    for r in res.values():
+        np.testing.assert_array_equal(r, np.full(1, 3.0))
+    assert rings[0].poll_joiners() == []  # request cleared at commit
+
+
+# -- fault sites (docs/robustness.md "Fault injection") ----------------------
+
+def test_kv_partition_drop_heals_and_counts():
+    c = LocalClient()
+    rings = _rings(c, [0, 1])
+    before = DIST_HEALTH.requeued
+    faults.inject("kv.partition", nth=1, kind="drop", times=3)
+    out = _run({r: (lambda rr=r: rings[rr].allreduce_sum(
+        np.full(1, float(rr)))) for r in rings})
+    np.testing.assert_array_equal(out[0], np.full(1, 1.0))
+    np.testing.assert_array_equal(out[1], np.full(1, 1.0))
+    assert DIST_HEALTH.requeued == before + 3
+
+
+def test_kv_partition_persistent_times_out_never_hangs():
+    c = LocalClient()
+    ring = Ring(c, 0, [0, 1], poll=0.0, op_timeout=0.05)
+    faults.inject("kv.partition", kind="drop", times=10 ** 9)
+    # rank 1 is alive and its key even lands — but this side's control
+    # link drops every read: the op must end in a deadline error
+    c.set("mxring/g0/red/0/1", b"\x01")
+    with pytest.raises(KVStoreTimeoutError):
+        ring.allreduce_sum(np.ones(1))
+
+
+def test_kv_worker_die_raising_kind_propagates():
+    c = LocalClient()
+    ring = Ring(c, 0, [0, 1], poll=0.0)
+    faults.inject("kv.worker_die", nth=1, kind="raise")
+    with pytest.raises(faults.InjectedFault):
+        ring.allreduce_sum(np.ones(1))
+    # the op never published: a retry after the fault clears is clean
+    assert not any("/red/" in k for k in c.dir(""))
+
+
+def test_kv_push_delay_site_registered():
+    from mxnet_tpu.kvstore import create
+    faults.inject("kv.push_delay", nth=1, kind="delay", delay=0.0)
+    kv = create("local")
+    before = faults.count("kv.push_delay")
+    # local stores never fire the dist push site; the site exists for the
+    # dist stores and the rule must not leak into local training
+    import mxnet_tpu.ndarray as nd
+    kv.init(3, nd.ones((2,)))
+    kv.push(3, nd.ones((2,)))
+    assert faults.count("kv.push_delay") == before

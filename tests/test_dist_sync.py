@@ -89,3 +89,18 @@ def test_dist_checkpoint_resume_mid_training(tmp_path):
     load + --load-epoch, example/image-classification/common/fit.py)."""
     _launch("resume", 2, timeout=900,
             extra_env={"MXTPU_TEST_TMPDIR": str(tmp_path)})
+
+
+@pytest.mark.slow
+def test_elastic_worker_loss_survival(tmp_path):
+    """SIGKILL one of three workers mid-epoch (kv.worker_die): survivors
+    must emergency-checkpoint, re-form the ring at N-1, re-shard, finish
+    to accuracy, stay bitwise consistent — and a fresh resume from the
+    same prefix must reproduce the live post-reform state exactly
+    (docs/robustness.md "Elastic distributed training")."""
+    nproc = 3
+    out = _launch("elastic", nproc, timeout=900, check_rc=False,
+                  expect_ranks=range(nproc - 1),
+                  extra_env={"MXTPU_TEST_TMPDIR": str(tmp_path)})
+    assert "RANK-%d-PASS" % (nproc - 1) not in out, \
+        "victim should never pass"

@@ -392,13 +392,15 @@ def test_heartbeat_startup_grace_not_dead_before_first_publish():
     from mxnet_tpu.kvstore import _Heartbeat
 
     class FakeClient(object):
+        """Speaks the read API dead_nodes actually uses: one dir scan
+        (this jaxlib has no key_value_try_get)."""
+
         def __init__(self, stamps):
             self.stamps = stamps
 
-        def key_value_try_get(self, key):
-            if key not in self.stamps:
-                raise KeyError(key)
-            return self.stamps[key]
+        def key_value_dir_get(self, prefix):
+            return [(k, v) for k, v in self.stamps.items()
+                    if k.startswith(prefix)]
 
     import time
     hb = _Heartbeat.__new__(_Heartbeat)

@@ -76,10 +76,18 @@ def test_dist_sync_single_process():
     kv.barrier()
 
 
-def test_dist_async_rejected():
-    import pytest
-    with pytest.raises(mx.base.NotImplementedForTPU):
-        kvs.create("dist_async")
+def test_dist_async_single_process():
+    """dist_async exists now (bounded-staleness SSP, docs/robustness.md);
+    single-process it degenerates to a local store with the async API."""
+    kv = kvs.create("dist_async")
+    assert kv.type == "dist_async"
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.init(0, nd.zeros((2,)))
+    kv.push(0, nd.ones((2,)) * 3)
+    out = nd.zeros((2,))
+    kv.pull(0, out=out)
+    assert (out.asnumpy() == 3).all()
+    assert kv.staleness >= 0  # the window knob (MXTPU_KV_STALENESS)
 
 
 def test_fault_policy_env_defaults(monkeypatch):
