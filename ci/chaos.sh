@@ -1,0 +1,15 @@
+#!/bin/sh
+# CI gate: seeded deterministic chaos harness (docs/robustness.md "Chaos
+# harness"). Proves the gate can turn RED (a deliberately inverted
+# invariant must fail a run), then drives MXTPU_CHAOS_ROUNDS seeded
+# fault plans through each of the four scenarios — fused-fit train,
+# data tier, REAL 3-process dist_sync, FleetRouter+DecodeLoop serve —
+# each in a watchdogged subprocess, demanding zero invariant violations
+# and zero hangs; replays every committed regression plan under
+# tests/chaos_plans/; and exercises the shrinker's reduction loop.
+# Emits CHAOS_r18.json.
+set -e
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    python tools/chaos_gate.py
+echo "chaos PASS"

@@ -69,6 +69,13 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # resume, and a collective-throughput floor vs 1 worker
 # (MXTPU_DIST_MIN_SCALE); emits DIST_r*.json
 ./ci/dist.sh
+# chaos gate (docs/robustness.md "Chaos harness"): RED self-test first
+# (a deliberately inverted invariant must fail a run), then seeded
+# composed-fault plans through all four scenarios — train/data/dist/
+# serve, each in a watchdogged subprocess — with zero violations and
+# zero hangs, committed-regression replays, and the shrinker loop;
+# emits CHAOS_r*.json
+./ci/chaos.sh
 # multichip gate (docs/perf.md "Data-parallel scaling"): MEASURED — 8-device
 # fused-fit img/s + scaling efficiency vs 1 device (floor
 # MXTPU_MULTICHIP_MIN_EFF, default 0.7), guard + bitwise checkpoint/resume

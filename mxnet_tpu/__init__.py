@@ -4,7 +4,7 @@ model — mixed symbolic/imperative — rebuilt on JAX/XLA/Pallas/pjit.
 See SURVEY.md at the repo root for the structural map of the reference
 (lyttonhao/mxnet, v0.9.5) this framework reproduces, TPU-first.
 """
-from .base import MXNetError, __version__
+from .base import MXNetError, TrainingPreemptedError, __version__
 from . import obs
 from . import autotune
 from . import faults
@@ -61,6 +61,7 @@ from .kvstore_server import _init_distributed as tools_init_distributed
 from . import predictor
 from .predictor import Predictor
 from . import serving
+from . import chaos
 # refresh op-function namespaces so late registrations (Custom) appear
 ndarray._init_ndarray_module()
 symbol._init_symbol_module()

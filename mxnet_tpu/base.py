@@ -19,6 +19,22 @@ class MXNetError(Exception):
     """Error raised by mxnet_tpu (parity: dmlc error -> MXGetLastError -> Python)."""
 
 
+class TrainingPreemptedError(MXNetError):
+    """``Module.fit`` received SIGTERM (the TPU-preemption shape) and shut
+    down gracefully: the dispatch pipeline was drained, an emergency
+    checkpoint sealed with the async writer drained, and the run exited
+    within ``MXTPU_SIGTERM_DEADLINE`` seconds. Catch it, note the
+    preemption, and re-launch with ``resume='auto'`` — training continues
+    bit-for-bit from the emergency checkpoint (docs/robustness.md
+    "Graceful preemption")."""
+
+    def __init__(self, msg, epoch=None, batches_done=None, tag=None):
+        self.epoch = epoch
+        self.batches_done = batches_done
+        self.tag = tag
+        super().__init__(msg)
+
+
 class NotImplementedForTPU(MXNetError):
     """A reference feature intentionally absent on the TPU substrate.
 

@@ -1,0 +1,34 @@
+"""Deterministic chaos harness (docs/robustness.md "Chaos harness").
+
+PRs 2/3/11/17 built recovery machinery tier by tier — checkpoint/resume,
+guard rollback, fleet requeue, elastic ring re-form — and every
+:mod:`mxnet_tpu.faults` site tests its seam ONE fault at a time.
+Production failures compose: a worker dies while an async checkpoint is
+in flight while a decode request is queued. This package proves the
+recovery paths under *combinations*:
+
+- :mod:`~mxnet_tpu.chaos.plan` — a :class:`ChaosPlan` is a seeded sample
+  of (site, kind, nth-call, intensity) rules drawn from the live
+  ``faults.py`` registry; JSON-serializable, replayable bit-for-bit, no
+  wall clock or global RNG anywhere.
+- :mod:`~mxnet_tpu.chaos.runner` — drives four real workloads under a
+  plan (fused K-step fit + async ckpt + guard; the data tier; a
+  3-process ``dist_sync`` fit via ``tools/launch.py``; FleetRouter +
+  DecodeLoop under open-loop load), each in a subprocess with a hang
+  watchdog.
+- :mod:`~mxnet_tpu.chaos.invariants` — typed-error-or-complete,
+  bitwise resume, exactly-once request settlement, health-counter
+  consistency, flight-recorder dump-and-parse.
+- :mod:`~mxnet_tpu.chaos.shrink` — greedy reduction of a failing plan to
+  the minimal failing schedule (the committed regression artifact).
+
+CLI: ``python -m mxnet_tpu.chaos --help`` (run/replay/shrink/emit-plan/
+audit-sites). CI gate: ``ci/chaos.sh`` + ``tools/chaos_gate.py``.
+"""
+from .plan import ChaosPlan, sample_plan
+from .invariants import check_scenario, Violation, INVARIANTS
+from .shrink import shrink_plan
+from .runner import SCENARIOS, run_plan
+
+__all__ = ["ChaosPlan", "sample_plan", "check_scenario", "Violation",
+           "INVARIANTS", "shrink_plan", "SCENARIOS", "run_plan"]

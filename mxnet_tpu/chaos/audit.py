@@ -1,0 +1,117 @@
+"""``python -m mxnet_tpu.chaos --audit-sites`` — registry/docs/tests
+three-way cross-check.
+
+Fault sites rot in two directions: a new site lands in ``faults.py``
+without documentation or coverage, or code moves and a documented site
+no longer exists. This audit pins all three views of the inventory to
+each other and runs as a tier-1 test, so drift fails the build:
+
+1. the live registry (:data:`mxnet_tpu.faults.SITES`),
+2. the site table in ``docs/robustness.md`` (between the
+   ``chaos-site-table`` markers),
+3. the test suite — every registered site must appear as a literal
+   string somewhere under ``tests/`` (the chaos smoke test fires each
+   site explicitly, so this is satisfiable by construction).
+"""
+from __future__ import annotations
+
+import os
+import re
+
+from .. import faults as _faults
+
+_BEGIN = "<!-- chaos-site-table:begin -->"
+_END = "<!-- chaos-site-table:end -->"
+
+
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def doc_sites(doc_path=None):
+    """Site names documented in robustness.md's marker-delimited table
+    (first backticked token of each table row)."""
+    path = doc_path or os.path.join(repo_root(), "docs", "robustness.md")
+    with open(path) as f:
+        text = f.read()
+    if _BEGIN not in text or _END not in text:
+        raise ValueError("%s: chaos-site-table markers missing" % path)
+    table = text.split(_BEGIN, 1)[1].split(_END, 1)[0]
+    sites = set()
+    for line in table.splitlines():
+        line = line.strip()
+        if not line.startswith("|") or set(line) <= set("|- "):
+            continue
+        m = re.match(r"\|\s*`([^`]+)`\s*\|", line)
+        if m and not m.group(1) == "site":
+            sites.add(m.group(1))
+    return sites
+
+
+def test_sites(tests_dir=None):
+    """Registered sites referenced as a literal string in tests/."""
+    root = tests_dir or os.path.join(repo_root(), "tests")
+    registered = set(_faults.SITES)
+    found = set()
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn)) as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for site in registered - found:
+                if ('"%s"' % site) in text or ("'%s'" % site) in text:
+                    found.add(site)
+        if found == registered:
+            break
+    return found
+
+
+def audit_sites(doc_path=None, tests_dir=None):
+    """Run the three-way check; returns a list of problem strings
+    (empty = clean)."""
+    registered = set(_faults.SITES)
+    problems = []
+
+    documented = doc_sites(doc_path)
+    for site in sorted(registered - documented):
+        problems.append(
+            "site %r is registered in faults.SITES but missing from the "
+            "docs/robustness.md site table" % site)
+    for site in sorted(documented - registered):
+        problems.append(
+            "site %r appears in the docs/robustness.md site table but is "
+            "not registered in faults.SITES" % site)
+
+    tested = test_sites(tests_dir)
+    for site in sorted(registered - tested):
+        problems.append(
+            "site %r is registered but no test under tests/ references "
+            "it as a literal string" % site)
+
+    # scenario strings must be ones the runner knows how to drive
+    from .runner import SCENARIOS
+    for name, info in sorted(_faults.SITES.items()):
+        for scen in info.scenarios:
+            if scen not in SCENARIOS:
+                problems.append(
+                    "site %r names unknown chaos scenario %r (runner "
+                    "knows: %s)" % (name, scen, ", ".join(SCENARIOS)))
+    return problems
+
+
+def main(out=print):
+    problems = audit_sites()
+    registered = sorted(_faults.SITES)
+    out("chaos site audit: %d registered site(s)" % len(registered))
+    if problems:
+        for p in problems:
+            out("PROBLEM: %s" % p)
+        out("AUDIT FAILED: %d problem(s)" % len(problems))
+        return 1
+    out("registry == docs table == test coverage: OK")
+    return 0

@@ -452,6 +452,11 @@ class Ring(object):
             attempts = sorted(int(k.rsplit("/", 1)[1]) for k in props)
             if not attempts:
                 if self.rank == min(live):
+                    # kv.reform_delay: a slow LEADER — the proposal lands
+                    # late; followers keep polling (they converge once it
+                    # appears) or hit the re-form deadline above, so a
+                    # straggling leader is bounded, never a hang
+                    _faults.fire("kv.reform_delay")
                     prop = sorted(set(live) | joiners)
                     self.client.set(
                         prop_prefix + "0",
@@ -477,6 +482,7 @@ class Ring(object):
                      and not self.client.alive(r)]
             if stale:
                 if self.rank == min(r for r in live if r in members):
+                    _faults.fire("kv.reform_delay")
                     prop = sorted((set(members) - set(stale)) | joiners)
                     self.client.set(
                         prop_prefix + "%d" % (att + 1),

@@ -19,6 +19,11 @@ site                         where it fires
 ``superbatch.producer``      top of the SuperBatchIter producer loop
 ``checkpoint.write``         before an atomic checkpoint file write
 ``checkpoint.write.mid``     mid-stream, after half the payload is written
+``ckpt.disk_full``           inside ``model.atomic_write_bytes`` after half
+                             the payload — the armed ``"enospc"`` kind
+                             simulates a full disk; the tmp file is cleaned
+                             up and an actionable ``MXNetError`` names the
+                             path (the live file is untouched)
 ``ckpt.async_write``         on the async checkpoint writer thread, before
                              a submitted save writes its first byte
                              (raise/transient => the save is dropped and
@@ -76,6 +81,12 @@ site                         where it fires
                              a ``"delay"`` rule makes this worker a
                              straggler, which the SSP window surfaces as
                              ``staleness_lag`` on its peers
+``kv.reform_delay``          before the re-form leader publishes a
+                             membership proposal — a ``"delay"`` rule makes
+                             the leader slow; survivors still converge (the
+                             proposal lands late) or raise
+                             ``KVStoreTimeoutError`` in bounded time, never
+                             a hang
 ``kv.partition``             per peer-key poll inside a ring fetch —
                              ``"drop"`` discards that poll (a lossy /
                              partitioned control link); finite rules heal
@@ -150,7 +161,109 @@ class _Rule(object):
 _lock = threading.RLock()
 _rules = {}     # site -> [_Rule]
 _counts = {}    # site -> total fire() calls
+_fired = {}     # site -> calls where an armed rule actually matched
 _env_loaded = False
+
+
+class SiteInfo(object):
+    """Static metadata for one registered fault site — what the chaos
+    harness (:mod:`mxnet_tpu.chaos`) samples from and what
+    ``python -m mxnet_tpu.chaos --audit-sites`` audits against docs and
+    tests. ``kinds`` are the rule kinds that exercise a REAL recovery path
+    at this site (chaos plans only sample these); ``flag`` marks
+    :func:`fire_flag` data-poison sites; ``scenarios`` names the chaos
+    scenarios whose workload reaches the site."""
+
+    __slots__ = ("name", "kinds", "flag", "scenarios", "doc")
+
+    def __init__(self, name, kinds, flag, scenarios, doc):
+        self.name = name
+        self.kinds = tuple(kinds)
+        self.flag = bool(flag)
+        self.scenarios = tuple(scenarios)
+        self.doc = doc
+
+    def describe(self):
+        return {"name": self.name, "kinds": list(self.kinds),
+                "flag": self.flag, "scenarios": list(self.scenarios),
+                "doc": self.doc}
+
+
+SITES = {}
+
+
+def _register(name, kinds, scenarios, doc, flag=False):
+    SITES[name] = SiteInfo(name, kinds, flag, scenarios, doc)
+
+
+# The static site registry. Keep in lockstep with the instrumented call
+# sites AND the site table in docs/robustness.md — the --audit-sites gate
+# fails on drift in either direction.
+_register("io.record_read", ("transient", "raise"), ("data",),
+          "per record read in image.ImageIter")
+_register("io.batch_read", ("transient", "raise"), ("train", "data"),
+          "per batch pull in io.SuperBatchIter")
+_register("io.h2d", ("transient", "raise"), ("train", "data"),
+          "per host->device superbatch slot transfer")
+_register("superbatch.producer", ("transient", "die"), ("train", "data"),
+          "top of the SuperBatchIter producer loop")
+_register("checkpoint.write", ("raise", "transient", "truncate"), ("train",),
+          "before an atomic checkpoint file write")
+_register("checkpoint.write.mid", ("raise",), ("train",),
+          "mid-stream, after half the checkpoint payload is written")
+_register("ckpt.disk_full", ("enospc",), ("train",),
+          "inside atomic_write_bytes — ENOSPC after half the payload; the "
+          "tmp file is cleaned up and an actionable MXNetError names the "
+          "path (the live file is untouched)")
+_register("ckpt.async_write", ("raise", "transient", "delay"), ("train",),
+          "async checkpoint writer thread, before a save's first byte")
+_register("ckpt.async_die", ("die",), ("train",),
+          "top of an async save — kills the writer thread mid-job")
+_register("guard.grad_nan", ("poison",), ("train",),
+          "per guarded train step — poisons gradients with NaN on device",
+          flag=True)
+_register("guard.loss_spike", ("poison",), ("train",),
+          "per guarded dispatch observation — inflates the watched loss",
+          flag=True)
+_register("guard.param_nan", ("poison",), ("train",),
+          "at checkpoint save — forces the known-good bit off", flag=True)
+_register("kvstore.push", ("transient", "delay"), ("dist",),
+          "before a KVStore push")
+_register("kvstore.pull", ("transient", "delay"), ("dist",),
+          "before a KVStore pull")
+_register("kvstore.barrier", ("transient", "delay"), ("dist",),
+          "before a KVStore barrier")
+_register("kvstore.dead_node", ("dead:1",), (),
+          "inside KVStore.check_health (simulated-dead-worker drill; not "
+          "chaos-sampled — the dist scenario kills REAL processes via "
+          "kv.worker_die instead)")
+_register("kv.worker_die", ("die",), ("dist",),
+          "top of every dist_ring.Ring collective — SIGKILLs this process")
+_register("kv.push_delay", ("delay",), ("dist",),
+          "before a dist push — makes this worker a straggler")
+_register("kv.partition", ("drop",), ("dist",),
+          "per peer-key poll inside a ring fetch — drops that poll")
+_register("kv.reform_delay", ("delay",), ("dist",),
+          "before the re-form leader publishes a membership proposal — a "
+          "slow leader; survivors converge late or raise in bounded time")
+_register("serve.enqueue_drop", ("drop",), ("serve",),
+          "per serving.Batcher.submit — back-pressure shed at the edge")
+_register("serve.decode_die", ("die",), ("serve",),
+          "top of every serving.DecodeLoop iteration — kills the loop")
+_register("fleet.replica_die", ("die",), ("serve",),
+          "per collected batch on a fleet replica — kills that replica")
+_register("data.worker_die", ("die", "raise"), ("data",),
+          "per claimed batch task in a data.DecodeWorkerPool worker")
+_register("data.decode_delay", ("delay",), ("data",),
+          "per batch task before the decode stage — a slow worker")
+
+
+def sites(scenario=None):
+    """The static site registry, optionally filtered to the sites a chaos
+    scenario's workload reaches. Returns ``{name: SiteInfo}``."""
+    if scenario is None:
+        return dict(SITES)
+    return {n: s for n, s in SITES.items() if scenario in s.scenarios}
 
 
 def _load_env_locked():
@@ -201,15 +314,66 @@ def clear(site=None):
         if site is None:
             _rules.clear()
             _counts.clear()
+            _fired.clear()
         else:
             _rules.pop(site, None)
             _counts.pop(site, None)
+            _fired.pop(site, None)
 
 
 def count(site):
     """Total ``fire`` calls seen at a site (for assertions in tests)."""
     with _lock:
         return _counts.get(site, 0)
+
+
+def fired(site):
+    """How many calls at ``site`` actually matched an armed rule — the
+    chaos invariant suite compares this against the injected plan (a
+    rule whose ``nth`` the workload never reached fired 0 times)."""
+    with _lock:
+        return _fired.get(site, 0)
+
+
+def fired_counts():
+    """Snapshot of every site's fired count (``{site: n}``, fired>0 only)."""
+    with _lock:
+        return {s: n for s, n in _fired.items() if n}
+
+
+def arm(rules):
+    """Arm a chaos plan: a list of rule dicts
+    (``{"site", "kind", "nth", "times", "delay"}``; ``times``/``delay``
+    optional). Unlike :func:`inject` this validates every site against the
+    static registry — a plan naming an unregistered site is a bug in the
+    plan, not a latent no-op."""
+    for r in rules:
+        site = r["site"]
+        if site not in SITES:
+            raise MXNetError(
+                "chaos plan names unregistered fault site %r (known: %s)"
+                % (site, ", ".join(sorted(SITES))))
+        inject(site, nth=int(r.get("nth", 1)), kind=r["kind"],
+               times=int(r.get("times", 1)),
+               delay=float(r.get("delay", 0.05)))
+
+
+class plan_scope(object):
+    """Context manager: arm a whole chaos plan (list of rule dicts, see
+    :func:`arm`) for the duration of a block, then disarm and reset every
+    site the plan touched."""
+
+    def __init__(self, rules):
+        self.rules = list(rules)
+
+    def __enter__(self):
+        arm(self.rules)
+        return self
+
+    def __exit__(self, *exc):
+        for site in {r["site"] for r in self.rules}:
+            clear(site)
+        return False
 
 
 def fire(site):
@@ -228,6 +392,7 @@ def fire(site):
         for rule in _rules.get(site, ()):
             if rule.covers(call_no):
                 hit = rule
+                _fired[site] = _fired.get(site, 0) + 1
                 break
     if hit is None:
         return None
@@ -263,6 +428,7 @@ def fire_flag(site):
         _counts[site] = call_no
         for rule in _rules.get(site, ()):
             if rule.covers(call_no):
+                _fired[site] = _fired.get(site, 0) + 1
                 return True
     return False
 

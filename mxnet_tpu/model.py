@@ -5,6 +5,7 @@ checkpointing, FeedForward :387).
 from __future__ import annotations
 
 import atexit
+import errno
 import glob
 import hashlib
 import json
@@ -124,9 +125,12 @@ def atomic_write_bytes(path, data):
     Fault sites: ``checkpoint.write`` (before any byte is written — a raise
     leaves the live file untouched), ``checkpoint.write.mid`` (after half
     the payload — a raise leaves only an orphaned ``.tmp-*``, never a
-    truncated live file). The injected ``truncate`` kind *does* publish a
-    torn file, simulating power loss between rename and data reaching disk;
-    the manifest checksum is what catches it at load time.
+    truncated live file), ``ckpt.disk_full`` (ENOSPC after half the
+    payload — the tmp file is removed and an actionable
+    :class:`MXNetError` names the path; a REAL ``ENOSPC`` from the
+    filesystem takes the same path). The injected ``truncate`` kind *does*
+    publish a torn file, simulating power loss between rename and data
+    reaching disk; the manifest checksum is what catches it at load time.
     """
     from . import faults as _faults
     path = os.fspath(path)
@@ -139,10 +143,24 @@ def atomic_write_bytes(path, data):
             half = len(data) // 2
             f.write(data[:half])
             _faults.fire("checkpoint.write.mid")
+            if _faults.fire("ckpt.disk_full") is not None:
+                raise OSError(errno.ENOSPC, "No space left on device", tmp)
             f.write(data[half:])
             f.flush()
             os.fsync(f.fileno())
         os.rename(tmp, path)
+    except OSError as e:
+        if e.errno == errno.ENOSPC:
+            # full disk mid-write: the finally below removes the partial
+            # tmp file, the live file at ``path`` was never touched
+            raise MXNetError(
+                "checkpoint write to %r failed: no space left on device "
+                "(ENOSPC). The partial temp file was removed and the "
+                "previous checkpoint generation is intact — free disk "
+                "space (or point checkpoint_prefix at another volume) and "
+                "re-run; resume='auto' continues from the newest valid "
+                "checkpoint" % (path,)) from e
+        raise
     finally:
         if os.path.exists(tmp):
             try:

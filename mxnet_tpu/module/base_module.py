@@ -10,7 +10,8 @@ from collections import deque, namedtuple
 
 import numpy as np
 
-from ..base import MXNetError, env_bool
+from ..base import (MXNetError, TrainingPreemptedError, env_bool,
+                    env_float)
 from .. import metric as _metric
 from .. import ndarray as nd
 from ..ndarray import NDArray
@@ -480,6 +481,39 @@ class BaseModule(object):
         from ..obs import flight as _obs_flight
         from ..kvstore import WorkerLostError as _WorkerLost
         _obs_flight.note("fit_start", epoch=begin_epoch)
+
+        # graceful preemption (docs/robustness.md "Graceful preemption"):
+        # SIGTERM is the TPU-preemption shape — the scheduler gives the VM
+        # a grace window, then pulls the plug. Install a handler that only
+        # SETS A FLAG (checked once per loop iteration, so the signal never
+        # interrupts a dispatch mid-flight) and starts a hard wall-clock
+        # deadline: a graceful exit that cannot finish in time degrades to
+        # an abrupt one, which the SIGKILL resume contract already covers.
+        # Installed only when there is a checkpoint manager to seal an
+        # emergency save into, and only on the main thread (signal() is
+        # main-thread-only; nested/threaded fits keep default delivery).
+        import signal as _signal
+        import threading as _threading
+        preempt = None
+        prev_sigterm = None
+        sigterm_installed = False
+        if (ckpt_mgr is not None
+                and not env_bool("MXTPU_SIGTERM_GRACEFUL_OFF")
+                and _threading.current_thread() is _threading.main_thread()):
+            preempt = {"flag": False, "timer": None}
+            _deadline_s = env_float("MXTPU_SIGTERM_DEADLINE", 30.0)
+
+            def _on_sigterm(signum, frame, _p=preempt, _d=_deadline_s):
+                if _p["flag"]:
+                    return
+                _p["flag"] = True
+                t = _threading.Timer(_d, os._exit, args=(124,))
+                t.daemon = True
+                t.start()
+                _p["timer"] = t
+            prev_sigterm = _signal.getsignal(_signal.SIGTERM)
+            _signal.signal(_signal.SIGTERM, _on_sigterm)
+            sigterm_installed = True
         try:
             epoch = begin_epoch
             while epoch < num_epoch:
@@ -609,6 +643,15 @@ class BaseModule(object):
                             drain_pipeline=lambda e=epoch: _consume(
                                 pipeline.drain(), e),
                             guard=guard)
+                        if preempt is not None and preempt["flag"]:
+                            # SIGTERM landed: retire everything in flight
+                            # (an emergency checkpoint must never seal a
+                            # state its sentinels haven't cleared), then
+                            # seal + raise — all inside the deadline timer
+                            _consume(pipeline.drain(), epoch)
+                            self._graceful_preempt(preempt, ckpt_mgr,
+                                                   guard, eval_metric,
+                                                   epoch, nbatch)
                         if stepped_eager and batch_end_callback is not None:
                             # eagerly-trained batches (per-step path): one
                             # callback at the current nbatch, exactly as
@@ -725,6 +768,12 @@ class BaseModule(object):
                     train_data.reset()
                 epoch += 1
         finally:
+            if sigterm_installed:
+                _signal.signal(_signal.SIGTERM, prev_sigterm)
+                # a SIGTERM that arrived too late to be honored (epoch tail,
+                # teardown) must not leave a live os._exit timer behind
+                if preempt["timer"] is not None:
+                    preempt["timer"].cancel()
             if ckpt_mgr is not None and ckpt_mgr.async_writer is not None:
                 # teardown barrier: the in-flight save lands (or is reaped)
                 # before fit returns; a writer fit created is shut down AND
@@ -794,6 +843,41 @@ class BaseModule(object):
         self._fused_host_step += nsteps - skipped
 
     # -- fault tolerance hooks (docs/robustness.md) ---------------------
+    def _graceful_preempt(self, preempt, ckpt_mgr, guard, eval_metric,
+                          epoch, nbatch):
+        """Honor a SIGTERM (docs/robustness.md "Graceful preemption"): the
+        dispatch pipeline is already drained by the caller — seal an
+        emergency checkpoint with the async writer drained on both sides
+        (so the save is never shed by back-pressure and is durably on disk
+        before we exit), dump the flight recorder, cancel the hard-deadline
+        timer and raise :class:`TrainingPreemptedError`. The checkpoint
+        cursor is ``nbatch + 1`` mid-epoch — strictly newer than the last
+        cadence save a SIGKILL at the same moment would resume from."""
+        tag = None
+        if ckpt_mgr is not None and (guard is None
+                                     or guard.ok_to_checkpoint()):
+            ckpt_mgr.drain()
+            with _obs_trace.span("checkpoint", epoch=epoch,
+                                 nbatch=nbatch + 1, preempt=True):
+                ckpt_mgr.save(self, epoch, nbatch + 1, metric=eval_metric)
+            ckpt_mgr.drain()
+            tag = "e%04d-b%08d" % (epoch, nbatch + 1)
+        self.logger.warning(
+            "SIGTERM: graceful preemption — emergency checkpoint %s sealed "
+            "at epoch %d batch %d; re-launch with resume='auto' to "
+            "continue", tag or "(none: guard mid-spike or no manager)",
+            epoch, nbatch + 1)
+        from ..obs import flight as _flight
+        _flight.dump("TrainingPreemptedError: SIGTERM preemption",
+                     extra={"epoch": epoch, "nbatch": nbatch, "tag": tag})
+        if preempt["timer"] is not None:
+            preempt["timer"].cancel()
+        raise TrainingPreemptedError(
+            "training preempted by SIGTERM at epoch %d batch %d "
+            "(emergency checkpoint: %s) — resume='auto' continues from it"
+            % (epoch, nbatch + 1, tag), epoch=epoch,
+            batches_done=nbatch + 1, tag=tag)
+
     def _guard_rollback(self, guard, ckpt_mgr):
         """Divergence recovery (docs/robustness.md "Numerical guardrails"):
         restore the newest known-good checkpoint, rewind the trainer clock

@@ -96,13 +96,26 @@ def main(prefix, out_npz, k):
             mgr.drain()
 
     from mxnet_tpu import lr_scheduler
-    mod.fit(train, num_epoch=2, steps_per_dispatch=k,
-            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
-                              "lr_scheduler": lr_scheduler.FactorScheduler(
-                                  step=10, factor=0.5)},
-            batch_end_callback=cb,
-            checkpoint_prefix=ckpt_arg, checkpoint_every_n_batches=4,
-            resume="auto")
+    # RESUME_WORKER_CKPT_EVERY overrides the cadence (the SIGTERM test
+    # sets it huge so only epoch-end saves exist — any mid-epoch tag then
+    # proves the graceful-preemption emergency checkpoint ran);
+    # RESUME_WORKER_TERM_OK=1 turns TrainingPreemptedError into a clean
+    # "PREEMPTED" exit so the parent can tell graceful from crashed.
+    every = int(os.environ.get("RESUME_WORKER_CKPT_EVERY", "4") or 4)
+    try:
+        mod.fit(train, num_epoch=2, steps_per_dispatch=k,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "lr_scheduler":
+                                  lr_scheduler.FactorScheduler(
+                                      step=10, factor=0.5)},
+                batch_end_callback=cb,
+                checkpoint_prefix=ckpt_arg, checkpoint_every_n_batches=every,
+                resume="auto")
+    except mx.TrainingPreemptedError as e:
+        if os.environ.get("RESUME_WORKER_TERM_OK"):
+            print("PREEMPTED %s" % e.tag, flush=True)
+            return
+        raise
     arg, aux = mod.get_params()
     np.savez(out_npz, **{n: v.asnumpy() for n, v in arg.items()})
     print("DONE", flush=True)

@@ -1,0 +1,416 @@
+"""Chaos harness unit tier (docs/robustness.md "Chaos harness").
+
+Everything here is tier-1-cheap: plan determinism (in-process AND across
+a subprocess), serialization round-trips, the greedy shrinker against
+synthetic run functions, the invariant judgments against synthetic fact
+sheets, the registry/docs/tests audit, and a smoke pass that fires every
+registered site once through ``faults.fire``/``fire_flag``. The real
+scenario executions live in the CI gate (``ci/chaos.sh`` →
+``tools/chaos_gate.py``), not in pytest.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu.chaos import (ChaosPlan, sample_plan, check_scenario,
+                             shrink_plan, INVARIANTS, SCENARIOS)
+from mxnet_tpu.chaos import audit as chaos_audit
+from mxnet_tpu.chaos.plan import PLAN_VERSION
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- the site registry + smoke: fire every registered site ------------------
+
+# the canonical literal inventory — the audit greps tests/ for each site
+# name, and this smoke proves each (site, kind) pair round-trips through
+# arm/fire. Keep in sync with faults.SITES (the test asserts equality).
+ALL_SITES = [
+    ("checkpoint.write", "raise"),
+    ("checkpoint.write.mid", "raise"),
+    ("ckpt.async_die", "die"),
+    ("ckpt.async_write", "raise"),
+    ("ckpt.disk_full", "enospc"),
+    ("data.decode_delay", "delay"),
+    ("data.worker_die", "die"),
+    ("fleet.replica_die", "die"),
+    ("guard.grad_nan", "poison"),
+    ("guard.loss_spike", "poison"),
+    ("guard.param_nan", "poison"),
+    ("io.batch_read", "transient"),
+    ("io.h2d", "transient"),
+    ("io.record_read", "transient"),
+    ("kv.partition", "drop"),
+    ("kv.push_delay", "delay"),
+    ("kv.reform_delay", "delay"),
+    ("kv.worker_die", "die"),
+    ("kvstore.barrier", "transient"),
+    ("kvstore.dead_node", "dead:1"),
+    ("kvstore.pull", "transient"),
+    ("kvstore.push", "transient"),
+    ("serve.decode_die", "die"),
+    ("serve.enqueue_drop", "drop"),
+    ("superbatch.producer", "die"),
+]
+
+
+def test_site_inventory_matches_registry():
+    assert [s for s, _ in ALL_SITES] == sorted(faults.SITES)
+    for site, kind in ALL_SITES:
+        info = faults.SITES[site]
+        assert kind in info.kinds, (site, kind, info.kinds)
+        assert info.doc, site
+        for scen in info.scenarios:
+            assert scen in SCENARIOS, (site, scen)
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("site,kind", ALL_SITES,
+                         ids=[s for s, _ in ALL_SITES])
+def test_every_registered_site_fires(site, kind):
+    """Each site's first registered kind round-trips arm -> fire ->
+    fired_counts — the coverage the chaos sampler builds on."""
+    flag = faults.SITES[site].flag
+    faults.inject(site, nth=1, kind=kind, delay=0.0)
+    if flag:
+        assert faults.fire_flag(site) is True
+    else:
+        try:
+            act = faults.fire(site)
+        except mx.MXNetError:
+            act = "raised"  # raise/transient kinds: typed, still counted
+        assert act is not None
+    assert faults.fired(site) == 1
+    assert faults.fired_counts() == {site: 1}
+    faults.clear()
+    assert faults.fired_counts() == {}
+
+
+def test_arm_rejects_unregistered_site():
+    with pytest.raises(mx.MXNetError, match="unregistered fault site"):
+        faults.arm([{"site": "no.such.site", "kind": "raise", "nth": 1}])
+
+
+def test_plan_scope_clears_on_exit():
+    rules = [{"site": "io.record_read", "kind": "raise", "nth": 1}]
+    with faults.plan_scope(rules):
+        with pytest.raises(mx.MXNetError):
+            faults.fire("io.record_read")
+        assert faults.fired("io.record_read") == 1
+    assert faults.fire("io.record_read") is None  # disarmed + reset
+
+
+def test_sites_filter_by_scenario():
+    for scen in SCENARIOS:
+        pool = faults.sites(scen)
+        assert pool, scen
+        for s in pool:
+            assert scen in faults.SITES[s].scenarios
+    # kvstore.dead_node is registered but deliberately never sampled
+    assert "kvstore.dead_node" in faults.SITES
+    assert all("kvstore.dead_node" not in faults.sites(s)
+               for s in SCENARIOS)
+
+
+# -- plan determinism -------------------------------------------------------
+
+def test_same_seed_same_plan_bytes():
+    for scen in SCENARIOS:
+        a = sample_plan(11, scen)
+        b = sample_plan(11, scen)
+        assert a == b and a.to_json() == b.to_json()
+        assert sample_plan(12, scen) != a
+
+
+def test_plan_deterministic_across_processes():
+    """The committable-regression property: a fresh interpreter (its own
+    PYTHONHASHSEED, import order, everything) emits byte-identical plan
+    JSON for the same seed."""
+    here = sample_plan(5, "train").to_json()
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.chaos", "--emit-plan",
+         "--seed", "5", "--scenario", "train"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert out.stdout == here
+
+
+def test_plan_samples_are_well_formed():
+    for scen in SCENARIOS:
+        for seed in range(20):
+            plan = sample_plan(seed, scen)
+            assert 1 <= len(plan) <= 4
+            died = 0
+            for r in plan.faults:
+                info = faults.SITES[r["site"]]
+                assert scen in info.scenarios
+                assert r["kind"] in info.kinds
+                assert r["nth"] >= 1 and r["times"] >= 1
+                assert 0.0 < r["delay"] <= 0.2
+                if scen == "dist":
+                    assert 0 <= r["rank"] <= 2
+                    if r["kind"] == "die":
+                        died += 1
+                        assert r["rank"] != 0, \
+                            "a plan must never kill rank 0 (it hosts " \
+                            "the coordination service)"
+                else:
+                    assert "rank" not in r
+            assert died <= 1  # max_per_plan on destructive rules
+
+
+def test_plan_roundtrip_and_version_gate(tmp_path):
+    plan = sample_plan(9, "serve")
+    path = plan.save(str(tmp_path / "p.json"))
+    loaded = ChaosPlan.load(path)
+    assert loaded == plan
+    assert loaded.to_json() == open(path).read()  # byte-for-byte
+    bad = plan.to_dict()
+    bad["version"] = PLAN_VERSION + 1
+    with pytest.raises(mx.MXNetError, match="plan version"):
+        ChaosPlan.from_dict(bad)
+
+
+def test_rules_for_rank_partitions_dist_plan():
+    plan = ChaosPlan(0, "dist", [
+        {"site": "kv.worker_die", "kind": "die", "nth": 9, "times": 1,
+         "delay": 0.05, "rank": 2},
+        {"site": "kvstore.pull", "kind": "transient", "nth": 1,
+         "times": 1, "delay": 0.05, "rank": 0},
+        {"site": "kv.push_delay", "kind": "delay", "nth": 3, "times": 1,
+         "delay": 0.1},          # no rank -> every rank arms it
+    ])
+    assert [r["site"] for r in plan.rules_for_rank(0)] == \
+        ["kvstore.pull", "kv.push_delay"]
+    assert [r["site"] for r in plan.rules_for_rank(2)] == \
+        ["kv.worker_die", "kv.push_delay"]
+    assert plan.sites() == ["kv.push_delay", "kv.worker_die",
+                            "kvstore.pull"]
+
+
+def test_committed_regression_plan_replays_byte_for_byte():
+    """tests/chaos_plans/ holds plans CI replays forever; each must be
+    exactly what its (seed, scenario) samples today — sampler drift
+    would silently change what the regression reproduces."""
+    plans_dir = os.path.join(os.path.dirname(__file__), "chaos_plans")
+    committed = sorted(os.listdir(plans_dir))
+    assert committed, "no committed regression plans"
+    for name in committed:
+        path = os.path.join(plans_dir, name)
+        raw = open(path).read()
+        plan = ChaosPlan.load(path)
+        assert plan.to_json() == raw, name
+        resampled = sample_plan(plan.seed, plan.scenario,
+                                n_faults=len(plan))
+        assert resampled.to_json() == raw, \
+            "%s: sampler drifted from the committed bytes" % name
+
+
+# -- the shrinker -----------------------------------------------------------
+
+def _mk_plan(sites):
+    return ChaosPlan(0, "train", [
+        {"site": s, "kind": "raise", "nth": i + 1, "times": 1,
+         "delay": 0.05} for i, s in enumerate(sites)])
+
+
+def test_shrink_drops_irrelevant_rules():
+    plan = _mk_plan(["io.batch_read", "checkpoint.write",
+                     "ckpt.async_write", "io.h2d"])
+
+    def violates(p):  # only the checkpoint.write+io.h2d pair matters
+        s = set(p.sites())
+        return "checkpoint.write" in s and "io.h2d" in s
+
+    shrunk, runs = shrink_plan(plan, violates)
+    assert shrunk.sites() == ["checkpoint.write", "io.h2d"]
+    assert violates(shrunk)
+    assert runs >= 4  # it actually re-ran candidates
+
+
+def test_shrink_single_culprit():
+    plan = _mk_plan(["io.batch_read", "checkpoint.write", "io.h2d"])
+    shrunk, _ = shrink_plan(plan, lambda p: "io.h2d" in p.sites())
+    assert shrunk.sites() == ["io.h2d"] and len(shrunk) == 1
+
+
+def test_shrink_keeps_minimal_plan_unchanged():
+    plan = _mk_plan(["io.batch_read", "io.h2d"])
+    shrunk, runs = shrink_plan(plan, lambda p: len(p) == 2)
+    assert shrunk == plan and runs == 2  # tried both drops, both passed
+
+
+# -- invariant judgments over synthetic fact sheets -------------------------
+
+def _result(**over):
+    base = {"scenario": "train", "outcome": "completed", "typed": True,
+            "fault_fired": {}, "fault_counts": {}, "health": {},
+            "flight": {"exists": True, "parses": True}}
+    base.update(over)
+    return base
+
+
+def _outcome(result=None, **over):
+    base = {"scenario": "train", "watchdog_fired": False, "rc": 0,
+            "wall_s": 1.0, "deadline_s": 240.0, "result": result}
+    base.update(over)
+    return base
+
+
+def _viols(plan, outcome):
+    return {v.invariant for v in check_scenario(plan, outcome)}
+
+
+def test_invariant_green_run_is_green():
+    plan = sample_plan(0, "train")
+    assert check_scenario(plan, _outcome(_result())) == []
+
+
+def test_invariant_watchdog_is_no_hang():
+    plan = sample_plan(0, "train")
+    assert _viols(plan, _outcome(None, watchdog_fired=True)) == \
+        {"no_hang"}
+
+
+def test_invariant_missing_result_is_bare_crash():
+    plan = sample_plan(0, "train")
+    assert _viols(plan, _outcome(None, rc=1)) == {"typed_outcome"}
+
+
+def test_invariant_untyped_error_flagged():
+    plan = sample_plan(0, "train")
+    res = _result(outcome="error", typed=False, error_type="ValueError",
+                  error_msg="boom")
+    assert "typed_outcome" in _viols(plan, _outcome(res))
+    res = _result(outcome="error", typed=True,
+                  error_type="InjectedFault", error_msg="injected")
+    assert check_scenario(plan, _outcome(res)) == []
+
+
+def test_invariant_settle_partition():
+    plan = sample_plan(1, "serve")
+    ok = {"submitted": 10, "completed": 7, "expired": 1, "shed": 1,
+          "failed": 1, "unsettled": 0}
+    assert check_scenario(
+        plan, _outcome(_result(scenario="serve", settle=ok))) == []
+    lost = dict(ok, completed=6, unsettled=1)
+    assert _viols(plan, _outcome(_result(scenario="serve",
+                                         settle=lost))) == \
+        {"settled_once"}
+
+
+def test_invariant_resume_and_stream():
+    plan = sample_plan(0, "train")
+    bad = _result(resume={"mode": "bitwise", "ok": False,
+                          "detail": "hash mismatch"})
+    assert _viols(plan, _outcome(bad)) == {"bitwise_resume"}
+    bad = _result(scenario="data",
+                  stream={"ok": False, "detail": "reordered"})
+    assert _viols(plan, _outcome(bad)) == {"bitwise_resume"}
+
+
+def test_invariant_health_consistency_grad_nan():
+    plan = ChaosPlan(0, "train", [
+        {"site": "guard.grad_nan", "kind": "poison", "nth": 1,
+         "times": 1, "delay": 0.05}])
+    fired = _result(fault_fired={"guard.grad_nan": 1})
+    assert _viols(plan, _outcome(fired)) == {"health_consistent"}
+    fired_ok = _result(fault_fired={"guard.grad_nan": 1},
+                       health={"training": {"skipped": 1}})
+    assert check_scenario(plan, _outcome(fired_ok)) == []
+
+
+def test_invariant_flight_dump_required_on_failure_sites():
+    plan = ChaosPlan(1, "serve", [
+        {"site": "fleet.replica_die", "kind": "die", "nth": 1,
+         "times": 1, "delay": 0.05}])
+    res = _result(scenario="serve",
+                  fault_fired={"fleet.replica_die": 1},
+                  flight={"exists": False, "parses": False})
+    assert _viols(plan, _outcome(res)) == {"flight_dump"}
+
+
+def test_invariant_dist_survivor_hash_divergence():
+    plan = sample_plan(13, "dist")
+    ranks = {0: _result(scenario="dist", final_hash="aa" * 32),
+             1: _result(scenario="dist", final_hash="bb" * 32),
+             2: None}
+    out = _outcome(None, scenario="dist", rank_results=ranks,
+                   expected_dead=[2], rc=137)
+    del out["result"]
+    assert _viols(plan, out) == {"bitwise_resume"}
+
+
+def test_break_invariant_env_inverts_verdict(monkeypatch):
+    """The gate's RED self-test hook: a green run turns red on the named
+    invariant, and a red run's matching violations are suppressed."""
+    plan = sample_plan(0, "train")
+    monkeypatch.setenv("MXTPU_CHAOS_BREAK_INVARIANT", "typed_outcome")
+    viols = check_scenario(plan, _outcome(_result()))
+    assert [v.invariant for v in viols] == ["typed_outcome"]
+    assert "deliberately inverted" in viols[0].detail
+    # a genuinely red run: its typed_outcome violations are dropped
+    red = _outcome(_result(outcome="error", typed=False,
+                           error_type="ValueError", error_msg="x"))
+    assert check_scenario(plan, red) == []
+
+
+# -- the audit --------------------------------------------------------------
+
+def test_audit_sites_clean():
+    """Tier-1 wiring of ``python -m mxnet_tpu.chaos --audit-sites``: the
+    live registry, the docs site table and test coverage agree."""
+    assert chaos_audit.audit_sites() == []
+
+
+def test_audit_detects_doc_drift(tmp_path):
+    doc = tmp_path / "robustness.md"
+    doc.write_text(
+        "<!-- chaos-site-table:begin -->\n"
+        "| site | kinds | scenarios | effect |\n|---|---|---|---|\n"
+        "| `io.record_read` | transient | data | x |\n"
+        "| `no.such.site` | raise | train | ghost |\n"
+        "<!-- chaos-site-table:end -->\n")
+    problems = chaos_audit.audit_sites(doc_path=str(doc))
+    assert any("'ckpt.disk_full'" in p and "missing from" in p
+               for p in problems)
+    assert any("'no.such.site'" in p and "not registered" in p
+               for p in problems)
+
+
+def test_audit_detects_missing_markers(tmp_path):
+    doc = tmp_path / "robustness.md"
+    doc.write_text("no table here\n")
+    with pytest.raises(ValueError, match="markers missing"):
+        chaos_audit.doc_sites(doc_path=str(doc))
+
+
+def test_audit_detects_untested_site(tmp_path):
+    (tmp_path / "test_x.py").write_text('faults.fire("io.record_read")\n')
+    problems = chaos_audit.audit_sites(tests_dir=str(tmp_path))
+    assert any("'ckpt.disk_full'" in p and "no test" in p
+               for p in problems)
+    assert not any("'io.record_read'" in p for p in problems)
+
+
+def test_audit_cli_exit_code():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.chaos", "--audit-sites"],
+        capture_output=True, text=True, timeout=120, cwd=root)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout

@@ -227,6 +227,48 @@ def test_checkpoint_write_abort_preserves_previous(tmp_path):
     assert st.tag == before.tag            # old generation intact
 
 
+@pytest.mark.faults
+def test_disk_full_mid_write_actionable_and_no_litter(tmp_path):
+    """ckpt.disk_full: ENOSPC halfway through an atomic write must (a)
+    surface as an actionable MXNetError naming the path and the remedy,
+    (b) remove the partial temp file, and (c) leave the live file's
+    previous contents untouched."""
+    path = str(tmp_path / "x.params")
+    atomic_write_bytes(path, b"generation-1")
+    faults.inject("ckpt.disk_full", nth=1, kind="enospc")
+    with pytest.raises(mx.MXNetError) as ei:
+        atomic_write_bytes(path, b"generation-2-never-lands")
+    faults.clear()
+    msg = str(ei.value)
+    assert "no space left on device" in msg and "ENOSPC" in msg
+    assert path in msg
+    assert "free disk space" in msg          # the remedy, not just the errno
+    assert open(path, "rb").read() == b"generation-1"
+    assert [f for f in os.listdir(str(tmp_path)) if ".tmp" in f] == [], \
+        "partial temp file littered after ENOSPC"
+    # disarmed, the same write path works again
+    atomic_write_bytes(path, b"generation-2")
+    assert open(path, "rb").read() == b"generation-2"
+
+
+@pytest.mark.faults
+def test_disk_full_during_manager_save_keeps_previous_generation(tmp_path):
+    X, y = _toy_data()
+    prefix = str(tmp_path / "ck")
+    _trained_module(X, y, prefix=prefix, every=4)
+    mgr = CheckpointManager(prefix)
+    before = mgr.load_latest()
+    mod2 = _trained_module(X, y)
+    faults.inject("ckpt.disk_full", nth=1, kind="enospc")
+    with pytest.raises(mx.MXNetError, match="no space left on device"):
+        mgr.save(mod2, 9, 0)
+    faults.clear()
+    st = mgr.load_latest()
+    assert st is not None and st.tag == before.tag
+    ckdir = os.path.dirname(prefix)
+    assert [f for f in os.listdir(ckdir) if ".tmp" in f] == []
+
+
 # -- legacy checkpoint API satellites ---------------------------------------
 
 def test_load_checkpoint_rejects_malformed_keys(tmp_path):
@@ -600,3 +642,67 @@ def test_restore_trainer_clock_reaches_kvstore_updater():
     assert mod._optimizer.num_update == 42
     assert kv._updater.optimizer.num_update == 42
     assert kv._updater.optimizer.begin_num_update == 42
+
+
+# -- graceful preemption: SIGTERM drains, emergency-checkpoints, resumes ----
+
+@pytest.mark.slow
+def test_sigterm_graceful_preempt_resumes_from_newer_checkpoint(tmp_path):
+    """The TPU-preemption shape (docs/robustness.md "Graceful
+    preemption"): SIGTERM mid-epoch must drain the dispatch pipeline,
+    take an emergency checkpoint at the exact batch cursor, and exit
+    cleanly via TrainingPreemptedError — and the relaunch must resume
+    from that STRICTLY NEWER checkpoint to bitwise-identical final
+    params. Cadence saves are disabled (RESUME_WORKER_CKPT_EVERY huge),
+    so the only mid-epoch tag that can exist is the emergency one —
+    unlike the SIGKILL drill, which loses everything since the last
+    cadence save."""
+    worker = os.path.join(os.path.dirname(__file__), "resume_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RESUME_WORKER_TERM_OK="1",
+               RESUME_WORKER_CKPT_EVERY="1000")
+
+    def launch(prefix, out):
+        return subprocess.Popen(
+            [sys.executable, worker, prefix, out, "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+
+    ref_out = str(tmp_path / "ref.npz")
+    p = launch(str(tmp_path / "ref-ck"), ref_out)
+    assert p.wait(timeout=600) == 0, p.stdout.read()
+
+    prefix = str(tmp_path / "ck")
+    out = str(tmp_path / "resumed.npz")
+    p = launch(prefix, out)
+    termed = False
+    tail = []
+    for line in p.stdout:
+        tail.append(line)
+        if not termed and line.startswith("BATCH 1."):
+            os.kill(p.pid, signal.SIGTERM)
+            termed = True
+        elif line.startswith("PREEMPTED"):
+            break
+    assert termed, "worker finished before it could be preempted"
+    assert p.wait(timeout=60) == 0, "".join(tail)
+    assert any(l.startswith("PREEMPTED") for l in tail), "".join(tail)
+    assert not os.path.exists(out)
+
+    # the emergency checkpoint is MID-epoch-1 — strictly newer than the
+    # epoch-end save (e0001-b00000000), which is all SIGKILL would keep
+    mgr = CheckpointManager(prefix)
+    st = mgr.load_latest()
+    assert st is not None and st.known_good is True
+    assert (st.epoch, st.batches_done) > (1, 0), st.tag
+    preempt_line = [l for l in tail if l.startswith("PREEMPTED")][0]
+    assert st.tag in preempt_line
+
+    p = launch(prefix, out)
+    assert p.wait(timeout=600) == 0, p.stdout.read()
+
+    ref = np.load(ref_out)
+    got = np.load(out)
+    assert sorted(ref.files) == sorted(got.files)
+    for name in ref.files:
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)

@@ -225,3 +225,48 @@ def test_kv_push_delay_site_registered():
     kv.init(3, nd.ones((2,)))
     kv.push(3, nd.ones((2,)))
     assert faults.count("kv.push_delay") == before
+
+
+# -- kv.reform_delay: a slow leader during ring re-form ----------------------
+
+@pytest.mark.faults
+def test_reform_delay_slow_leader_survivors_still_converge():
+    """kv.reform_delay stalls the LEADER (min live rank) right before it
+    publishes the membership proposal; the follower keeps polling and
+    both survivors must still converge on the same re-formed ring."""
+    import time
+    c = LocalClient()
+    rings = _rings(c, [0, 1, 2])
+    c.mark_dead(2)
+    faults.inject("kv.reform_delay", nth=1, kind="delay", delay=0.3)
+    t0 = time.monotonic()
+    out = _run({0: rings[0].reform, 1: rings[1].reform})
+    elapsed = time.monotonic() - t0
+    assert out[0] == out[1] == [0, 1]
+    assert rings[0].gen == rings[1].gen == 1
+    assert faults.fired("kv.reform_delay") == 1  # leader only, once
+    assert elapsed >= 0.3                        # the stall was real
+    # the re-formed ring still reduces correctly
+    red = _run({r: (lambda rr=r: rings[rr].allreduce_sum(
+        np.full(2, float(rr + 1)))) for r in (0, 1)})
+    np.testing.assert_array_equal(red[0], np.full(2, 3.0))
+    np.testing.assert_array_equal(red[1], np.full(2, 3.0))
+
+
+@pytest.mark.faults
+def test_reform_delay_beyond_deadline_raises_bounded():
+    """A leader stalled PAST the re-form deadline must not hang anyone:
+    every survivor raises KVStoreTimeoutError in bounded time (the
+    docs/robustness.md 'converge or raise in bounded time' contract)."""
+    import time
+    c = LocalClient()
+    rings = _rings(c, [0, 1, 2], op_timeout=0.5)
+    c.mark_dead(2)
+    faults.inject("kv.reform_delay", nth=1, kind="delay", delay=2.0)
+    t0 = time.monotonic()
+    out = _run({r: (lambda rr=r: pytest.raises(
+        KVStoreTimeoutError, rings[rr].reform)) for r in (0, 1)})
+    elapsed = time.monotonic() - t0
+    assert set(out) == {0, 1}
+    assert elapsed < 15.0, "re-form timeout was not bounded"
+    assert faults.fired("kv.reform_delay") == 1

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""CI gate: the seeded deterministic chaos harness
+(docs/robustness.md "Chaos harness").
+
+Four legs, in order:
+
+1. **RED self-test** — before trusting a single green verdict, prove the
+   plumbing can fail: one cheap scenario runs with
+   ``MXTPU_CHAOS_BREAK_INVARIANT=typed_outcome`` (the invariant checker
+   deliberately inverts that verdict) and the gate DEMANDS a violation.
+   A harness that cannot turn red gates nothing.
+2. **Seeded rounds** — ``MXTPU_CHAOS_ROUNDS`` (default 3) plans per
+   scenario, seeds ``MXTPU_CHAOS_SEED + round``. Every round must come
+   back with zero violations and zero watchdog fires: each plan's
+   composed faults either recover (bitwise-resume / exactly-once
+   settlement / health-counter consistency hold) or fail typed.
+3. **Regression replays** — every committed plan under
+   ``tests/chaos_plans/`` is replayed; these are schedules worth pinning
+   forever (a worker-die + slow-reform-leader compose, a torn-write +
+   mid-run-raise compose, ...), and the plan JSON's byte-for-byte
+   determinism is what makes the replay exact.
+4. **Shrinker exercise** — the first seeded plan is shrunk under the
+   inverted-invariant judge (every run "fails", so the shrinker must
+   reduce to a single rule in a bounded number of re-runs) — the
+   reduction loop stays covered without needing a real standing bug.
+
+Emits CHAOS_r18.json (committed, like the DIST_r*.json series).
+Knobs: MXTPU_CHAOS_SEED (default 0), MXTPU_CHAOS_ROUNDS (default 3),
+MXTPU_CHAOS_DEADLINE (per-scenario watchdog override).
+"""
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from mxnet_tpu.base import env_int  # noqa: E402
+from mxnet_tpu.chaos import (ChaosPlan, sample_plan, check_scenario,
+                             shrink_plan, SCENARIOS)  # noqa: E402
+from mxnet_tpu.chaos.runner import run_plan  # noqa: E402
+
+OUT = os.path.join(ROOT, "CHAOS_r18.json")
+PLANS_DIR = os.path.join(ROOT, "tests", "chaos_plans")
+
+
+def _fail(msg):
+    print("chaos gate FAIL: %s" % msg)
+    sys.exit(1)
+
+
+def _judge(plan, workdir):
+    outcome = run_plan(plan, workdir)
+    violations = check_scenario(plan, outcome)
+    return outcome, violations
+
+
+def _round_record(plan, outcome, violations):
+    return {"scenario": plan.scenario, "seed": plan.seed,
+            "plan": plan.describe(), "n_faults": len(plan),
+            "wall_s": round(outcome["wall_s"], 2),
+            "watchdog_fired": outcome["watchdog_fired"],
+            "violations": [v.to_dict() for v in violations]}
+
+
+def main():
+    import tempfile
+    base = tempfile.mkdtemp(prefix="mxtpu-chaos-gate-")
+    seed0 = env_int("MXTPU_CHAOS_SEED", 0)
+    rounds = env_int("MXTPU_CHAOS_ROUNDS", 3)
+    report = {"schema": "mxtpu-chaos-gate-v1", "seed": seed0,
+              "rounds": rounds, "red_self_test": None,
+              "scenarios": {}, "regressions": [], "shrink": None}
+
+    # -- leg 1: the gate must be able to turn RED ----------------------
+    os.environ["MXTPU_CHAOS_BREAK_INVARIANT"] = "typed_outcome"
+    try:
+        plan = sample_plan(seed0, "serve")
+        _outcome, viols = _judge(plan, os.path.join(base, "red"))
+    finally:
+        del os.environ["MXTPU_CHAOS_BREAK_INVARIANT"]
+    if not viols:
+        _fail("RED self-test: the deliberately broken invariant "
+              "produced a GREEN run — the gate's plumbing proves "
+              "nothing. Check MXTPU_CHAOS_BREAK_INVARIANT handling in "
+              "chaos/invariants.py.")
+    report["red_self_test"] = {"violations": [v.to_dict() for v in viols],
+                               "ok": True}
+    print("[red self-test] broken invariant turned the run red: OK")
+
+    # -- leg 2: seeded rounds per scenario -----------------------------
+    t0 = time.time()
+    for scenario in SCENARIOS:
+        recs = []
+        for rnd in range(rounds):
+            seed = seed0 + rnd
+            plan = sample_plan(seed, scenario)
+            wd = os.path.join(base, "%s-s%d" % (scenario, seed))
+            outcome, viols = _judge(plan, wd)
+            rec = _round_record(plan, outcome, viols)
+            recs.append(rec)
+            status = "GREEN" if not viols else "RED"
+            print("[%s seed=%d] %s %.1fs  %s"
+                  % (scenario, seed, status, outcome["wall_s"],
+                     plan.describe()))
+            if viols:
+                for v in viols:
+                    print("  VIOLATION [%s] %s" % (v.invariant, v.detail))
+                print("  worker log: %s" % outcome["log"])
+                _fail("%s seed=%d: %d violation(s)"
+                      % (scenario, seed, len(viols)))
+        report["scenarios"][scenario] = recs
+
+    # -- leg 3: committed regression replays ---------------------------
+    for name in sorted(os.listdir(PLANS_DIR)):
+        plan = ChaosPlan.load(os.path.join(PLANS_DIR, name))
+        wd = os.path.join(base, "regress-%s" % name.replace(".json", ""))
+        outcome, viols = _judge(plan, wd)
+        rec = _round_record(plan, outcome, viols)
+        rec["file"] = name
+        report["regressions"].append(rec)
+        print("[regression %s] %s %.1fs"
+              % (name, "GREEN" if not viols else "RED",
+                 outcome["wall_s"]))
+        if viols:
+            for v in viols:
+                print("  VIOLATION [%s] %s" % (v.invariant, v.detail))
+            _fail("regression replay %s: %d violation(s)"
+                  % (name, len(viols)))
+
+    # -- leg 4: shrink loop under the inverted judge -------------------
+    plan = sample_plan(seed0, "serve")
+    os.environ["MXTPU_CHAOS_BREAK_INVARIANT"] = "typed_outcome"
+    try:
+        counter = {"n": 0}
+
+        def violates(candidate):
+            counter["n"] += 1
+            wd = os.path.join(base, "shrink-%d" % counter["n"])
+            _o, v = _judge(candidate, wd)
+            return bool(v)
+
+        shrunk, runs = shrink_plan(plan, violates, log=print)
+    finally:
+        del os.environ["MXTPU_CHAOS_BREAK_INVARIANT"]
+    if len(shrunk) != 1:
+        _fail("shrinker: an always-failing judge must reduce to ONE "
+              "rule, got %d" % len(shrunk))
+    report["shrink"] = {"from": len(plan), "to": len(shrunk),
+                        "runs": runs, "minimal": shrunk.describe()}
+    print("[shrink] %d -> %d rule(s) in %d re-run(s)"
+          % (len(plan), len(shrunk), runs))
+
+    report["wall_s"] = round(time.time() - t0, 1)
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("chaos gate PASS (%d scenarios x %d rounds + %d regressions, "
+          "%.0fs) -> %s"
+          % (len(SCENARIOS), rounds, len(report["regressions"]),
+             report["wall_s"], OUT))
+
+
+if __name__ == "__main__":
+    main()
