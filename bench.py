@@ -64,6 +64,19 @@ Knobs: BENCH_SERVE_MODEL (mlp|lenet, default mlp), BENCH_SERVE_QPS
 (default 200), BENCH_SERVE_REQS (default 400), BENCH_SERVE_CLIENTS
 (default 4), plus the MXTPU_SERVE_* batcher knobs (docs/env_var.md).
 
+BENCH_DECODE=1 switches to the production-decode-path bench
+(docs/serving.md "Production decode path"): per-leg A/B tokens/sec for
+in-graph sampling, int8 weights (HBM reduction + quality gate), the
+prefix cache and speculative decoding, each against the same greedy-f32
+DecodeLoop baseline — the BENCH_decode_rNN.json number. Knobs:
+BENCH_DECODE_REQS (8), BENCH_DECODE_NEW (24), BENCH_DECODE_SLOTS (4),
+BENCH_DECODE_VOCAB (64), BENCH_DECODE_EMBED (32), BENCH_DECODE_LAYERS
+(2), BENCH_DECODE_HEADS (2), BENCH_DECODE_LEN (64), BENCH_DECODE_SPEC_K
+(2). Honest expectations on CPU: prefix reuse wins outright; speculation
+is dispatch-bound (the draft chain adds K+1 host round-trips per round)
+and ships default-off; int8 trades dequant compute for the recorded ~4x
+weight-HBM win.
+
 BENCH_FLEET=1 switches to the fleet latency bench (docs/serving.md "Fleet
 tier"): N replicas (each its own AOT engine + Batcher) behind a
 FleetRouter, open-loop arrivals at a QPS one replica cannot hold, a mixed
@@ -698,6 +711,146 @@ def serve_main():
     }
     out.update(mem_fields)
     out["obs"] = _obs_block()
+    print(json.dumps(out))
+
+
+def _decode_lm_params(cfg, num_layers, seed):
+    """Random f32 transformer-LM params for the decode bench (weights
+    don't affect throughput; the int8 leg re-derives its own from these)."""
+    from mxnet_tpu import models
+    sym = models.transformer(vocab_size=cfg["vocab"], embed=cfg["embed"],
+                             num_heads=cfg["heads"],
+                             num_layers=num_layers, seq_len=cfg["len"])
+    arg_shapes, _, _ = sym.infer_shape(data=(1, cfg["len"]),
+                                       softmax_label=(1, cfg["len"]))
+    rs = np.random.RandomState(seed)
+    params = {n: (rs.randn(*s) * 0.3).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), arg_shapes)
+              if n not in ("data", "softmax_label")}
+    return sym, params
+
+
+def decode_main():
+    """Production-decode-path bench (docs/serving.md "Production decode
+    path"): per-leg A/B tokens/sec for the four decode features —
+    in-graph sampling, int8 weights (with the HBM win and the quality
+    gate), prefix-cache reuse, speculative decoding (with the
+    token-identity cross-check) — each against the same greedy-f32
+    baseline loop. One JSON line (the BENCH_decode_rNN.json number)."""
+    from mxnet_tpu import serving, tracecheck
+    from mxnet_tpu.serving.quantize import check_quality
+
+    nreq = benv("BENCH_DECODE_REQS")
+    max_new = benv("BENCH_DECODE_NEW")
+    slots = benv("BENCH_DECODE_SLOTS")
+    spec_k = benv("BENCH_DECODE_SPEC_K")
+    cfg = {"vocab": benv("BENCH_DECODE_VOCAB"),
+           "embed": benv("BENCH_DECODE_EMBED"),
+           "layers": benv("BENCH_DECODE_LAYERS"),
+           "heads": benv("BENCH_DECODE_HEADS"),
+           "len": benv("BENCH_DECODE_LEN")}
+    sym, params = _decode_lm_params(cfg, cfg["layers"], seed=0)
+    _dsym, draft = _decode_lm_params(cfg, 1, seed=1)
+
+    rs = np.random.RandomState(2)
+    shared = [int(t) for t in rs.randint(1, cfg["vocab"], 8)]
+    tails = [[int(t) for t in rs.randint(1, cfg["vocab"], 2 + i % 3)]
+             for i in range(nreq)]
+    prompts = [shared + t for t in tails]
+    seeds = [101 + i for i in range(nreq)]
+
+    def run(loop, temp, plen=0):
+        """One warmed A/B measurement: tokens/sec over the fixed request
+        batch (and the emitted streams, for the identity cross-checks)."""
+        def once():
+            futs = [loop.generate(p, max_new, temperature=temp,
+                                  seed=s, prefix_len=plen)
+                    for p, s in zip(prompts, seeds)]
+            return [f.result(timeout=300.0) for f in futs]
+        once()                                    # warm (primes prefixes)
+        t0 = time.perf_counter()
+        outs = once()
+        dt = time.perf_counter() - t0
+        return sum(len(o) for o in outs) / dt, outs
+
+    mk = lambda **kw: serving.DecodeLoop(
+        params, num_layers=cfg["layers"], num_heads=cfg["heads"],
+        max_len=cfg["len"], slots=slots, **kw)
+    legs, findings = {}, 0
+
+    base = mk(quantize="none", prefix_cache=False)
+    base_tps, _ = run(base, temp=0.0)
+    sampled_tps, sampled_outs = run(base, temp=0.8)
+    findings += len(base.check(memory=True))
+    base.close()
+    legs["greedy_f32"] = {"tokens_per_sec": round(base_tps, 1)}
+    legs["sampled"] = {"tokens_per_sec": round(sampled_tps, 1)}
+
+    q = mk(quantize="int8", prefix_cache=False)
+    int8_tps, _ = run(q, temp=0.8)
+    findings += len(q.check(memory=True))
+    int8_bytes = q.weight_bytes()
+    q.close()
+    # the quality gate runs through the engine pair — the documented
+    # quant workflow (docs/serving.md "Quantized weights")
+    ref_eng = serving.ServingEngine(sym, params, {"data": (cfg["len"],)},
+                                    buckets=(4,))
+    q_eng = serving.ServingEngine(sym, params, {"data": (cfg["len"],)},
+                                  buckets=(4,), quantize="int8")
+    probe = np.zeros((4, cfg["len"]), np.float32)
+    probe[:, :8] = np.asarray([shared] * 4, np.float32)
+    quality = q_eng.quality_report(ref_eng, {"data": probe})
+    check_quality(quality, who="bench-decode int8")
+    f32_bytes = ref_eng.weight_bytes()
+    legs["int8"] = {
+        "tokens_per_sec": round(int8_tps, 1),
+        "weight_bytes_f32": f32_bytes,
+        "weight_bytes_int8": int8_bytes,
+        "weight_hbm_reduction": round(1.0 - int8_bytes / f32_bytes, 4),
+        "top1_agreement": round(quality["top1_agreement"], 4),
+    }
+
+    pre = mk(quantize="none", prefix_cache=True)
+    prefix_tps, _ = run(pre, temp=0.8, plen=len(shared))
+    findings += len(pre.check(memory=True))
+    legs["prefix"] = {"tokens_per_sec": round(prefix_tps, 1),
+                      "prefix_hits": pre.health.prefix_hits,
+                      "prefix_prefills": pre.health.prefix_prefills}
+    pre.close()
+
+    spec = mk(quantize="none", prefix_cache=False, spec_k=spec_k,
+              draft_params=draft, draft_num_layers=1)
+    spec_tps, spec_outs = run(spec, temp=0.8)
+    findings += len(spec.check(memory=True))
+    h = spec.health
+    legs["spec_k%d" % spec_k] = {
+        "tokens_per_sec": round(spec_tps, 1),
+        "accept_rate": round(h.spec_accepted / max(1, h.spec_drafted), 4),
+        # the correctness contract, measured, not assumed: speculative
+        # output is token-identical to target-only under the same seeds
+        "token_identical": spec_outs == sampled_outs,
+    }
+    spec.close()
+    if spec_outs != sampled_outs:
+        raise RuntimeError("speculative decode diverged from target-only "
+                           "sampling under identical seeds")
+
+    for leg in legs.values():
+        leg["x_vs_greedy_f32"] = round(
+            leg["tokens_per_sec"] / max(base_tps, 1e-9), 3)
+    out = {
+        "metric": "decode_path_l%d_e%d_v%d" % (cfg["layers"],
+                                               cfg["embed"], cfg["vocab"]),
+        "value": round(base_tps, 1),
+        "unit": "tokens_per_sec_greedy_f32",
+        "requests": nreq,
+        "max_new": max_new,
+        "slots": slots,
+        "legs": legs,
+        "tracecheck_findings": findings,
+        "retraces": tracecheck.retrace_count(),
+        "obs": _obs_block(),
+    }
     print(json.dumps(out))
 
 
@@ -1482,6 +1635,8 @@ if __name__ == "__main__":
         lm_main()
     elif benv("BENCH_FLEET"):
         fleet_main()
+    elif benv("BENCH_DECODE"):
+        decode_main()
     elif benv("BENCH_SERVE"):
         serve_main()
     elif benv("BENCH_HOST_OVERHEAD"):

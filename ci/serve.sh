@@ -40,4 +40,62 @@ print("  %s: p50 %.2f ms, p99 %.2f ms, %.1f req/s, findings 0"
       % (r["metric"], r["p50_ms"], r["p99_ms"], r["throughput_rps"]))
 '
 done
+
+# sampled+quantized phase: the SAME open-loop client, but the engine
+# loads int8 weights (MXTPU_SERVE_QUANT) — the quantized program set must
+# stay lint-clean and shed nothing
+echo "ci/serve.sh: mlp int8-quantized (buckets 1,8; qps 50)"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    BENCH_SERVE=1 BENCH_SERVE_MODEL=mlp \
+    BENCH_SERVE_QPS=50 BENCH_SERVE_REQS=60 BENCH_SERVE_CLIENTS=3 \
+    MXTPU_SERVE_BUCKETS="1,8" MXTPU_SERVE_QUANT=int8 \
+    python bench.py | tail -n 1 | CAP_MS="$CAP_MS" python -c '
+import json, os, sys
+r = json.loads(sys.stdin.readline())
+bad = []
+if r["tracecheck_findings"]:
+    bad.append("tracecheck findings on the quantized program set: %d"
+               % r["tracecheck_findings"])
+if r["failed"]:
+    bad.append("%d requests failed on the quantized engine" % r["failed"])
+if r["p99_ms"] > float(os.environ["CAP_MS"]):
+    bad.append("quantized p99 %.1f ms over the smoke cap" % r["p99_ms"])
+if bad:
+    sys.exit("ci/serve.sh FAIL (%s int8): %s" % (r["metric"], "; ".join(bad)))
+print("  %s (int8): p50 %.2f ms, p99 %.2f ms, findings 0"
+      % (r["metric"], r["p50_ms"], r["p99_ms"]))
+'
+
+# decode-path phase: sampled decode through all four legs (docs/serving.md
+# "Production decode path") — the quality gate runs INSIDE bench.py
+# (check_quality raises = nonzero exit), so this asserts the structural
+# facts: zero findings, the int8 HBM win, spec token-identity
+echo "ci/serve.sh: decode path (sampling/int8/prefix/spec)"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    BENCH_DECODE=1 \
+    python bench.py | tail -n 1 | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+bad = []
+if r["tracecheck_findings"]:
+    bad.append("tracecheck findings on the decode program set: %d"
+               % r["tracecheck_findings"])
+legs = r["legs"]
+int8 = legs["int8"]
+if int8["weight_hbm_reduction"] < 0.40:
+    bad.append("int8 weight-HBM reduction %.2f below the 40%% floor"
+               % int8["weight_hbm_reduction"])
+spec = [v for k, v in legs.items() if k.startswith("spec_k")][0]
+if not spec["token_identical"]:
+    bad.append("speculative decode diverged from target-only sampling")
+if legs["prefix"]["prefix_hits"] < 1:
+    bad.append("prefix cache never hit")
+if bad:
+    sys.exit("ci/serve.sh FAIL (%s): %s" % (r["metric"], "; ".join(bad)))
+print("  %s: base %.0f tok/s, int8 -%.0f%% weight HBM (agree %.3f), "
+      "prefix x%.2f, spec identical" % (
+          r["metric"], r["value"],
+          int8["weight_hbm_reduction"] * 100, int8["top1_agreement"],
+          legs["prefix"]["x_vs_greedy_f32"]))
+'
 echo "serve smoke PASS"

@@ -25,22 +25,24 @@ import logging
 
 from ..base import MXNetError, env_str
 from . import db as _db
-from .db import (TuningDB, default_db_path, load_cached, parse_buckets,
-                 symbol_signature)
+from .db import (TuningDB, default_db_path, load_cached, param_signature,
+                 parse_buckets, symbol_signature)
 from .search import Knob, SearchDriver, Trial, NEG_INF
-from .space import serve_space, space_for, train_space
+from .space import decode_space, serve_space, space_for, train_space
 
 __all__ = [
     "TuningDB", "SearchDriver", "Trial", "Knob", "NEG_INF",
-    "default_db_path", "symbol_signature", "parse_buckets",
-    "train_space", "serve_space", "space_for",
+    "default_db_path", "symbol_signature", "param_signature",
+    "parse_buckets",
+    "train_space", "serve_space", "decode_space", "space_for",
     "enabled", "tune", "resolve_train_knobs", "resolve_serve_knobs",
-    "resolve_fit_knobs", "note_db_resolution",
-    "TRAIN_OBJECTIVES", "SERVE_OBJECTIVES",
+    "resolve_decode_knobs", "resolve_fit_knobs", "note_db_resolution",
+    "TRAIN_OBJECTIVES", "SERVE_OBJECTIVES", "DECODE_OBJECTIVES",
 ]
 
 TRAIN_OBJECTIVES = ("img_per_sec", "tokens_per_sec")
 SERVE_OBJECTIVES = ("serve_p99", "serve_p50")
+DECODE_OBJECTIVES = ("decode_tokens_per_sec",)
 
 
 def enabled():
@@ -140,6 +142,38 @@ def resolve_serve_knobs(symbol, logger=None):
     return None, None
 
 
+def resolve_decode_knobs(params, logger=None):
+    """Tuning-DB knobs for a :class:`~mxnet_tpu.serving.DecodeLoop` over
+    ``params`` (a flat ``name -> array`` dict — the decode loop has no
+    Symbol, so entries match on :func:`param_signature`); returns the
+    knobs dict or ``None``, never raises, and logs the resolution once
+    on a hit (the loop's own arg/env precedence has already been
+    applied by the caller)."""
+    if not enabled():
+        return None
+    try:
+        sig = param_signature(params)
+        tdb = load_cached(logger=logger)
+        note = None
+        for objective in DECODE_OBJECTIVES:
+            key, entry, obj_note = tdb.lookup("decode", symbol_sig=sig,
+                                              global_batch=0,
+                                              objective=objective)
+            note = note or obj_note
+            if entry is not None:
+                knobs = dict(entry.get("knobs") or {})
+                if knobs:
+                    note_db_resolution(logger, "DecodeLoop", key, knobs)
+                return knobs
+        if note:
+            _note_mismatch(logger, note)
+    except Exception as e:
+        (logger or logging).warning(
+            "autotune: tuning-DB resolution failed (%r) — decode knobs "
+            "fall back to built-in defaults", e)
+    return None
+
+
 def resolve_fit_knobs(module, train_data, steps_per_dispatch,
                       dispatch_pipeline, logger=None):
     """``Module.fit``'s knob resolution (docs/perf.md "Autotuning"):
@@ -214,7 +248,7 @@ def tune(model="mlp", objective="img_per_sec", budget=24, batch=None,
     ``write_db`` the best trial lands in the tuning DB (atomic write),
     keyed ``(model, device_kind, global_batch, objective)``.
     """
-    from .harness import ServeHarness, TrainHarness
+    from .harness import DecodeHarness, ServeHarness, TrainHarness
     logger = logger or logging
     if objective in TRAIN_OBJECTIVES:
         h = TrainHarness(model=model, batch=batch, objective=objective,
@@ -231,16 +265,29 @@ def tune(model="mlp", objective="img_per_sec", budget=24, batch=None,
                          **kw)
         sp = space or serve_space()
         global_batch = 0
+    elif objective in DECODE_OBJECTIVES:
+        kw = {}
+        if nreq is not None:
+            kw["nreq"] = nreq
+        h = DecodeHarness(model=model, objective=objective, logger=logger,
+                          **kw)
+        sp = space or decode_space()
+        global_batch = 0
     else:
         raise MXNetError(
-            "autotune: unknown objective %r (training: %s; serving: %s)"
+            "autotune: unknown objective %r (training: %s; serving: %s; "
+            "decode: %s)"
             % (objective, "|".join(TRAIN_OBJECTIVES),
-               "|".join(SERVE_OBJECTIVES)))
+               "|".join(SERVE_OBJECTIVES), "|".join(DECODE_OBJECTIVES)))
     driver = SearchDriver(sp, h.evaluate, prune=h.prune,
                           program_knobs=h.program_knobs, budget=budget,
                           trial_timeout=trial_timeout, logger=logger,
                           log=log)
-    best, trials = driver.run()
+    try:
+        best, trials = driver.run()
+    finally:
+        if hasattr(h, "close"):
+            h.close()   # decode trials hold live loop threads
     default = driver.default_trial
     result = {
         "model": model,

@@ -20,8 +20,8 @@ def _values(spec, typ):
 
 
 def main(argv=None):
-    from . import (SERVE_OBJECTIVES, TRAIN_OBJECTIVES, serve_space,
-                   train_space, tune)
+    from . import (DECODE_OBJECTIVES, SERVE_OBJECTIVES, TRAIN_OBJECTIVES,
+                   decode_space, serve_space, train_space, tune)
     p = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.autotune",
         description="Search the performance-knob space for one model and "
@@ -31,7 +31,8 @@ def main(argv=None):
                    help="zoo model name (training objectives) or mlp|lenet "
                         "(serving objectives); default mlp")
     p.add_argument("--objective", default="img_per_sec",
-                   choices=list(TRAIN_OBJECTIVES) + list(SERVE_OBJECTIVES))
+                   choices=(list(TRAIN_OBJECTIVES) + list(SERVE_OBJECTIVES)
+                            + list(DECODE_OBJECTIVES)))
     p.add_argument("--budget", type=int, default=24,
                    help="max trials (default 24); spaces larger than the "
                         "budget switch from exhaustive grid to greedy "
@@ -65,6 +66,12 @@ def main(argv=None):
     p.add_argument("--latency", default=None, metavar="MS,MS,...",
                    help="max_latency_ms candidates (serving; default "
                         "5,2,10)")
+    p.add_argument("--spec-k", default=None, metavar="K,K,...",
+                   help="speculative draft-depth candidates (decode; "
+                        "default 0,2,4 — 0 disables speculation)")
+    p.add_argument("--prefix", default=None, metavar="B,B,...",
+                   help="prefix_cache candidates as 0/1 (decode; default "
+                        "1,0)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-trial progress lines")
     args = p.parse_args(argv)
@@ -76,6 +83,13 @@ def main(argv=None):
                 spd_values=_values(args.spd, int) if args.spd else None,
                 pipeline_values=(_values(args.pipeline, int)
                                  if args.pipeline else None))
+    elif args.objective in DECODE_OBJECTIVES:
+        if args.spec_k or args.prefix:
+            space = decode_space(
+                spec_k_values=(_values(args.spec_k, int)
+                               if args.spec_k else None),
+                prefix_values=(_values(args.prefix, int)
+                               if args.prefix else None))
     else:
         if args.buckets or args.latency:
             space = serve_space(

@@ -75,6 +75,17 @@ BENCH_KNOBS = {k.name: k for k in [
     BenchKnob("BENCH_SERVE_QPS", "float", 200.0),
     BenchKnob("BENCH_SERVE_REQS", "int", 400),
     BenchKnob("BENCH_SERVE_CLIENTS", "int", 4),
+    # decode-path mode (sampling / quantization / prefix / speculative)
+    BenchKnob("BENCH_DECODE", "flag", False),
+    BenchKnob("BENCH_DECODE_REQS", "int", 8),
+    BenchKnob("BENCH_DECODE_NEW", "int", 24),
+    BenchKnob("BENCH_DECODE_SLOTS", "int", 4),
+    BenchKnob("BENCH_DECODE_VOCAB", "int", 64),
+    BenchKnob("BENCH_DECODE_EMBED", "int", 32),
+    BenchKnob("BENCH_DECODE_LAYERS", "int", 2),
+    BenchKnob("BENCH_DECODE_HEADS", "int", 2),
+    BenchKnob("BENCH_DECODE_LEN", "int", 64),
+    BenchKnob("BENCH_DECODE_SPEC_K", "int", 2),
     # fleet mode
     BenchKnob("BENCH_FLEET", "flag", False),
     BenchKnob("BENCH_FLEET_REPLICAS", "int", 2),

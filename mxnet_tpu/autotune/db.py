@@ -63,6 +63,24 @@ def symbol_signature(symbol):
     return "%08x" % (zlib.crc32(blob) & 0xffffffff)
 
 
+def param_signature(params):
+    """Structural fingerprint of a flat ``name -> array`` parameter dict
+    (the decode loop is built from raw params, not a Symbol): crc32 over
+    the sorted ``(name, shape, dtype)`` triples. Weight VALUES don't
+    change the signature; any architecture change (layer count, width,
+    vocab) does — the same no-leak contract as
+    :func:`symbol_signature`. Quantized ``{"q","s"}`` leaves sign their
+    int8 payload, so a loop resolved before and after quantization
+    matches the same entry only if the stored layout matches."""
+    items = []
+    for k in sorted(params):
+        v = params[k]
+        a = v["q"] if isinstance(v, dict) and "q" in v else v
+        items.append((str(k), tuple(int(d) for d in a.shape),
+                      str(a.dtype)))
+    return "%08x" % (zlib.crc32(repr(items).encode()) & 0xffffffff)
+
+
 def _device_kind():
     import jax
     d = jax.devices()[0]

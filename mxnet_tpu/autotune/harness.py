@@ -398,3 +398,120 @@ class ServeHarness(object):
                              "%s" % errors[:3])
         lat_ms = np.asarray(lat) * 1e3
         return -float(np.percentile(lat_ms, self.pct))
+
+
+def _lm_params(symbol, seq_len, seed):
+    """Random f32 params for a ``models.transformer`` symbol (weights
+    don't affect decode throughput)."""
+    arg_shapes, _, _ = symbol.infer_shape(data=(1, seq_len),
+                                          softmax_label=(1, seq_len))
+    rs = np.random.RandomState(seed)
+    return {n: (rs.randn(*s) * 0.3).astype(np.float32)
+            for n, s in zip(symbol.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+
+
+class DecodeHarness(object):
+    """Decode-objective trials (``decode_tokens_per_sec``): a
+    :class:`~mxnet_tpu.serving.DecodeLoop` over a tiny transformer LM
+    with a 1-layer co-resident draft, driven by a fixed request batch
+    whose prompts share a common prefix — so the prefix-cache and
+    speculative knobs both have something to win on. The score is
+    emitted tokens per wall second.
+
+    Knobs consumed: ``spec_k`` (0 disables speculation; changes the
+    compiled program set — verify+draft bodies), ``prefix_cache``
+    (0/1; adds the prefix get/put programs). Both are program knobs,
+    so each candidate compiles once and the pruner's memcheck pass
+    sees the REAL resident set — including the draft+target pair.
+    """
+
+    kind = "decode"
+    program_knobs = ("spec_k", "prefix_cache")
+
+    #: tiny-but-real LM: 2 target layers + 1 draft layer, one shared
+    #: vocab — big enough that spec/prefix change the work, small enough
+    #: for a per-candidate compile inside the trial timeout
+    _CFG = dict(vocab_size=32, embed=16, num_heads=2, num_layers=2,
+                seq_len=48)
+
+    def __init__(self, model="lm", objective="decode_tokens_per_sec",
+                 nreq=6, max_new=16, logger=None):
+        from .. import models
+        if objective != "decode_tokens_per_sec":
+            raise MXNetError("autotune: decode objective must be "
+                             "decode_tokens_per_sec, got %r" % (objective,))
+        self.model = model
+        self.objective = objective
+        self.unit = "tokens/sec"
+        self.nreq = int(nreq)
+        self.max_new = int(max_new)
+        cfg = dict(self._CFG)
+        self.symbol = models.transformer(**cfg)
+        self._cfg = cfg
+        self._params = _lm_params(self.symbol, cfg["seq_len"], seed=0)
+        dcfg = dict(cfg)
+        dcfg["num_layers"] = 1
+        self._draft = _lm_params(models.transformer(**dcfg),
+                                 cfg["seq_len"], seed=1)
+        rs = np.random.RandomState(2)
+        self._shared = [int(t) for t in
+                        rs.randint(1, cfg["vocab_size"], 6)]
+        self._tails = [[int(t) for t in rs.randint(1, cfg["vocab_size"],
+                                                   2 + i % 3)]
+                       for i in range(self.nreq)]
+        self._loops = {}
+
+    def symbol_sig(self):
+        # decode loops are built from raw params, not a Symbol — entries
+        # match on the PARAM signature the loop's own resolution computes
+        from .db import param_signature
+        return param_signature(self._params)
+
+    def _loop(self, knobs):
+        key = (int(knobs["spec_k"]), int(knobs.get("prefix_cache", 1)))
+        if key not in self._loops:
+            from ..serving import DecodeLoop
+            k, prefix = key
+            self._loops[key] = DecodeLoop(
+                self._params, num_layers=self._cfg["num_layers"],
+                num_heads=self._cfg["num_heads"],
+                max_len=self._cfg["seq_len"], slots=4,
+                spec_k=k, draft_params=(self._draft if k else None),
+                draft_num_layers=1, prefix_cache=bool(prefix),
+                quantize="none")
+        return self._loops[key]
+
+    def prune(self, knobs):
+        loop = self._loop(knobs)
+        return budget_findings(
+            loop.memory_report().values(),
+            "autotune/%s/decode[spec_k=%s,prefix=%s]"
+            % (self.model, knobs["spec_k"],
+               knobs.get("prefix_cache", 1)))
+
+    def evaluate(self, knobs):
+        loop = self._loop(knobs)
+        prefix = bool(int(knobs.get("prefix_cache", 1)))
+        plen = len(self._shared) if prefix else 0
+
+        def run():
+            futs = [loop.generate(self._shared + tail, self.max_new,
+                                  temperature=0.8, seed=7 + i,
+                                  prefix_len=plen)
+                    for i, tail in enumerate(self._tails)]
+            return sum(len(f.result(timeout=120.0)) for f in futs)
+
+        run()  # warmup: compile is done at load, but prime the prefix
+        t0 = time.perf_counter()
+        toks = run()
+        dt = time.perf_counter() - t0
+        if toks <= 0 or dt <= 0:
+            raise MXNetError("autotune decode trial emitted no tokens "
+                             "for knobs %r" % (knobs,))
+        return toks / dt
+
+    def close(self):
+        for loop in self._loops.values():
+            loop.close()
+        self._loops.clear()

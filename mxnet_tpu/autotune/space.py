@@ -29,7 +29,19 @@ def serve_space(bucket_values=None, latency_values=None):
     ]
 
 
+def decode_space(spec_k_values=None, prefix_values=None):
+    """Decode objective: speculative draft depth (0 disables) and
+    prefix-cache reuse (docs/serving.md "Production decode path"). Both
+    change the compiled program set."""
+    return [
+        Knob("spec_k", tuple(spec_k_values or (0, 2, 4))),
+        Knob("prefix_cache", tuple(prefix_values or (1, 0))),
+    ]
+
+
 def space_for(objective, **overrides):
     if objective in ("img_per_sec", "tokens_per_sec"):
         return train_space(**overrides)
+    if objective == "decode_tokens_per_sec":
+        return decode_space(**overrides)
     return serve_space(**overrides)

@@ -458,6 +458,35 @@ def _serve_lm_params():
             for k, v in p.items()}
 
 
+def _serve_draft_params():
+    """A 1-layer draft sibling of :func:`_serve_lm_params` (same vocab/
+    embed) so the serve scenario exercises the speculative-decode round —
+    the ``serve.spec_verify`` site only fires between a draft chain and
+    its target verify pass."""
+    import numpy as np
+    rs = np.random.RandomState(7)
+    embed, vocab, max_len = 16, 32, 24
+    p = {"tok_embed_weight": rs.randn(vocab, embed) * 0.3,
+         "pos_embed_weight": rs.randn(max_len, embed) * 0.1,
+         "final_ln_gamma": np.ones(embed),
+         "final_ln_beta": np.zeros(embed),
+         "lm_head_weight": rs.randn(vocab, embed) * 0.3,
+         "lm_head_bias": np.zeros(vocab),
+         "layer0_ln1_gamma": np.ones(embed),
+         "layer0_ln1_beta": np.zeros(embed),
+         "layer0_ln2_gamma": np.ones(embed),
+         "layer0_ln2_beta": np.zeros(embed),
+         "layer0_attn_qkv_weight": rs.randn(3 * embed, embed) * 0.2,
+         "layer0_attn_qkv_bias": np.zeros(3 * embed),
+         "layer0_attn_out_weight": rs.randn(embed, embed) * 0.2,
+         "layer0_attn_out_bias": np.zeros(embed),
+         "layer0_ffn_fc1_weight": rs.randn(4 * embed, embed) * 0.2,
+         "layer0_ffn_fc1_bias": np.zeros(4 * embed),
+         "layer0_ffn_fc2_weight": rs.randn(embed, 4 * embed) * 0.2,
+         "layer0_ffn_fc2_bias": np.zeros(embed)}
+    return {k: np.asarray(v, "float32") for k, v in p.items()}
+
+
 def worker_serve(plan, out_path, workdir):
     import numpy as np
     import mxnet_tpu as mx
@@ -511,7 +540,19 @@ def worker_serve(plan, out_path, workdir):
         for prompt in ([3, 5, 7], [2, 4], [9, 1, 6]):
             settle["submitted"] += 1
             try:
-                futures.append(loop.generate(prompt, 4))
+                futures.append(loop.generate(prompt, 4,
+                                             temperature=0.7, seed=11))
+            except MXNetError:
+                settle["failed"] += 1
+        # speculative leg: draft-K-then-verify rounds, so the
+        # serve.sample / serve.spec_verify sites are both reachable
+        sloop = serving.DecodeLoop(
+            _serve_lm_params(), 2, 4, 24, slots=2, spec_k=2,
+            draft_params=_serve_draft_params(), draft_num_layers=1)
+        for prompt in ([4, 8, 2], [6, 3]):
+            settle["submitted"] += 1
+            try:
+                futures.append(sloop.generate(prompt, 4))
             except MXNetError:
                 settle["failed"] += 1
         for fut in futures:
@@ -527,6 +568,7 @@ def worker_serve(plan, out_path, workdir):
                     settle["unsettled"] += 1   # the future NEVER resolved
                 else:
                     settle["failed"] += 1
+        sloop.close()
         loop.close()
         router.close()
     except Exception as exc:

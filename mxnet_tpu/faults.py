@@ -51,6 +51,18 @@ site                         where it fires
                              — ``"die"`` (or any raising kind) kills the
                              loop thread, which sheds every in-flight and
                              queued sequence with ``ServingClosedError``
+``serve.sample``             per decode step, after the sampling knobs are
+                             gathered but before the sampled-token dispatch
+                             — ``"raise"`` kills the loop thread mid-step;
+                             every in-flight sequence must be shed with
+                             ``ServingClosedError`` (no hang, no partial
+                             token emission)
+``serve.spec_verify``        between a speculative round's draft chain and
+                             its batched target verify pass — ``"raise"``
+                             dies with draft tokens proposed but NOT yet
+                             verified; the shed path must not emit any of
+                             them (draft output is never trusted without
+                             the target's verdict)
 ``fleet.replica_die``        once per collected batch on every
                              fleet-managed replica's batching thread —
                              ``"die"`` (or any raising kind) kills that
@@ -250,6 +262,13 @@ _register("serve.enqueue_drop", ("drop",), ("serve",),
           "per serving.Batcher.submit — back-pressure shed at the edge")
 _register("serve.decode_die", ("die",), ("serve",),
           "top of every serving.DecodeLoop iteration — kills the loop")
+_register("serve.sample", ("raise",), ("serve",),
+          "per decode step before the sampled-token dispatch — a raising "
+          "kind sheds every in-flight sequence (ServingClosedError)")
+_register("serve.spec_verify", ("raise",), ("serve",),
+          "between the draft chain and the batched target verify pass of "
+          "a speculative round — the loop dies mid-round; no draft token "
+          "may have been emitted without verification")
 _register("fleet.replica_die", ("die",), ("serve",),
           "per collected batch on a fleet replica — kills that replica")
 _register("data.worker_die", ("die", "raise"), ("data",),

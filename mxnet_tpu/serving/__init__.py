@@ -12,7 +12,21 @@ Three layers over the standalone :class:`~mxnet_tpu.predictor.Predictor`:
   (``MXTPU_SERVE_*``).
 * :class:`DecodeLoop` — slot-based continuous batching for the
   transformer LM: the KV cache is donated device state stepped by one
-  compiled decode body; sequences join and leave mid-stream.
+  compiled decode body; sequences join and leave mid-stream. The
+  production decode path layers four separately-benchable legs on top,
+  each behind a knob (docs/serving.md):
+
+  - **in-graph sampling** (temperature/top-k/top-p, per-slot seed
+    streams riding the donated state; ``temperature=0`` is bitwise the
+    greedy path),
+  - **weight quantization** (``quantize="bf16"|"int8"``, per-channel
+    scales, dequant inside the body, quality-gated via
+    :func:`check_quality`),
+  - **prefix/KV-cache reuse** (shared prompts prefilled once,
+    slot-cloned on join; LRU ``MXTPU_SERVE_PREFIX_MAX``),
+  - **speculative decoding** (``spec_k`` draft tokens per round from a
+    co-resident draft model, verified by ONE batched target pass;
+    token-identical to target-only decoding under the same seeds).
 * :class:`FleetRouter` — N data-parallel replicas (each its own engine +
   batcher, single-chip or model-axis-sharded via
   ``ServingEngine(contexts=...)``) behind priority-aware least-loaded
@@ -28,6 +42,8 @@ from .batcher import (Batcher, ServingError, ServingDeadlineError,
                       ServingOverloadedError, ServingClosedError)
 from .decode import DecodeLoop, GenerateFuture
 from .fleet import FleetRouter, FleetRequest, CLASSES as FLEET_CLASSES
+from .quantize import (QUANT_MODES, check_quality, quality_report,
+                       quantize_tree, tree_bytes)
 
 __all__ = [
     "ServingEngine", "Batcher", "DecodeLoop", "GenerateFuture",
@@ -35,4 +51,6 @@ __all__ = [
     "ServingHealth", "SERVING_HEALTH", "default_buckets",
     "ServingError", "ServingDeadlineError", "ServingOverloadedError",
     "ServingClosedError",
+    "QUANT_MODES", "check_quality", "quality_report", "quantize_tree",
+    "tree_bytes",
 ]

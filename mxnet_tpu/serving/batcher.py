@@ -76,8 +76,9 @@ class ServingClosedError(ServingError):
 
 class Settleable(object):
     """Once-only request settle protocol shared by the batcher's
-    :class:`_Request` and the fleet's
-    :class:`~mxnet_tpu.serving.fleet.FleetRequest`: first settle wins (the
+    :class:`_Request`, the fleet's
+    :class:`~mxnet_tpu.serving.fleet.FleetRequest` and the decode loop's
+    :class:`~mxnet_tpu.serving.decode.GenerateFuture`: first settle wins (the
     serving thread fulfilling vs. a waiter expiring the deadline race on
     the same request), the event is set before the ``on_done`` callback
     runs, and a callback exception can never kill the settling thread."""

@@ -1,5 +1,6 @@
-"""Continuous-batching decode loop for the transformer LM
-(docs/serving.md "Decode loop").
+"""Continuous-batching decode stack for the transformer LM
+(docs/serving.md "Decode loop" + the four production legs: "Sampling",
+"Quantized weights", "Prefix cache", "Speculative decoding").
 
 Autoregressive serving is a different animal from batch inference: each
 sequence wants ONE token per model pass, sequences finish at different
@@ -8,38 +9,81 @@ is the standard continuous-batching shape (the Gemma-on-TPU serving
 comparison, arXiv:2605.25645; Orca-style slot scheduling) on the donated
 dispatch substrate PR 1/PR 4 built for training:
 
-* the KV cache is DEVICE STATE, donated across steps — the decode body is
-  one AOT-compiled program ``(cache, params, tokens, pos) -> (cache,
-  logits)`` whose cache buffers are reused in place, exactly like the train
-  step's donated parameter state;
+* the KV cache — plus each slot's RNG seed — is DEVICE STATE, donated
+  across steps: the decode body is one AOT-compiled program ``(state,
+  params, tokens, pos, temp, top_k, top_p, fresh_seed, reseed) ->
+  (state, next_tokens)`` whose buffers are reused in place, exactly like
+  the train step's donated parameter state;
 * sequences occupy SLOTS: a new request joins any free slot mid-stream
   (its prompt is teacher-forced through the same decode body, one token
   per step, overwriting whatever the retired occupant left in the cache —
   positions past ``pos`` are masked, so stale rows are unreachable);
-* the host only supplies next tokens and reads back logits (one small
-  readback per step — the irreducible serving analog of the K-step metric
-  readback).
+* the host only supplies next tokens and reads back the SAMPLED token
+  ids (one (slots,) int32 readback per step — smaller than the logits
+  readback it replaced).
 
-Greedy decoding through this loop is token-for-token identical to full
-re-forward decoding through the AOT engine (tests/test_serving.py parity).
+The four legs, each behind a knob (docs/serving.md has the full table):
 
-Fault site ``serve.decode_die`` fires at the top of every loop iteration;
-the ``die`` kind (or any raising kind) kills the loop thread, which SHEDS
+**Sampling** (per request: ``temperature``/``top_k``/``top_p``/``seed``)
+happens IN-GRAPH via :mod:`.sampling`: the uniform for a slot's sample at
+cache position ``p`` is a pure function of ``(seed, p)``, so a sequence's
+token stream is deterministic under a fixed seed no matter which
+co-riders join or retire around it, and ``temperature=0`` is bitwise the
+greedy argmax path the loop always had.
+
+**Quantized weights** (``quantize=``/``MXTPU_SERVE_QUANT``: ``none`` |
+``bf16`` | ``int8``): per-channel scales computed at load by
+:mod:`.quantize`, dequant inside the body, so memcheck's resident
+accounting sees the int8/bf16 weight bytes (the HBM win
+:meth:`DecodeLoop.weight_bytes` reports); a sharded loop holds 1/N of
+the QUANTIZED bytes per chip.
+
+**Prefix cache** (``prefix_cache=``/``MXTPU_SERVE_PREFIX_CACHE``, on by
+default; capacity ``MXTPU_SERVE_PREFIX_MAX``): ``generate(...,
+prefix_len=L)`` names the shared system prompt ``prompt[:L]``. The first
+sequence to decode it has its KV slab extracted and cached ON DEVICE;
+later joins implant the slab into their slot and skip re-teacher-forcing
+the common prefix entirely. Sampling determinism is unaffected — the RNG
+depends only on (seed, absolute position).
+
+**Speculative decoding** (``spec_k=``/``MXTPU_SERVE_SPEC_K`` +
+``draft_params=``): a small draft LM co-resident beside the target
+(memcheck's resident-set lint audits the pair at load). Each round the
+draft proposes K tokens through K+1 cheap single-token passes, then ONE
+batched target pass scores all K+1 positions and samples every position
+with the same (seed, position) uniforms the single-token body would have
+used. Because the sample at a position is a deterministic function of
+(prefix, uniform) — not of the draft — acceptance is exact prefix
+matching and the emitted stream is token-identical to target-only
+decoding; a draft that equals the target gets 100% acceptance
+(docs/serving.md "Speculative decoding" has the acceptance math). The
+verify body UNROLLS the window through the same per-position pass as the
+single-token body, so each position computes the identical op sequence.
+
+Fault sites (docs/robustness.md): ``serve.decode_die`` fires at the top
+of every loop iteration; ``serve.sample`` at the top of every
+sampled-decode dispatch; ``serve.spec_verify`` before each speculative
+verify dispatch. Any raising kind kills the loop thread, which SHEDS
 every in-flight and queued sequence with :class:`ServingClosedError` —
 callers get a clear error, never a hang.
 """
 from __future__ import annotations
 
+import collections
+import logging
 import queue
 import threading
 import time
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, env_int, env_str
 from ..obs import trace as _obs
-from .batcher import REQUEST_IDS, ServingClosedError
+from .batcher import REQUEST_IDS, ServingClosedError, Settleable
 from .health import ServingHealth, SERVING_HEALTH
+from .quantize import (dequant_tree, is_quantized_leaf, quantize_array,
+                       quantize_tree, resolve_mode, tree_bytes)
+from .sampling import position_uniforms, sample_rows, validate_sampling
 
 
 def _ln(x, gamma, beta):
@@ -50,16 +94,28 @@ def _ln(x, gamma, beta):
     return (x - mean) * jax.lax.rsqrt(var + jnp.float32(1e-5)) * gamma + beta
 
 
-def _build_decode_fn(num_layers, num_heads, mesh=None):
-    """The decode body: one token per slot through every layer, reading
-    and writing the (layers, slots, heads, max_len, head_dim) KV cache.
-    Matches models/transformer.py op-for-op (pre-LN blocks, qkv packing,
-    1/sqrt(d) scaling) so greedy decode agrees with the full forward.
+def _build_token_pass(num_layers, num_heads, mesh=None):
+    """ONE position per slot through every layer, reading and writing the
+    (layers, slots, heads, rows, head_dim) KV cache. Matches
+    models/transformer.py op-for-op (pre-LN blocks, qkv packing, 1/sqrt(d)
+    scaling) so greedy decode agrees with the full forward.
+
+    This is the shared per-position pass: the single-token decode body
+    runs it once, the speculative verify body unrolls it over the window —
+    a position computes the IDENTICAL op sequence through either, which is
+    what makes speculative output token-identical to target-only decode.
+
+    The write/embed position is clamped to the last cache row: a
+    speculative cache carries one extra TRASH row (``rows = max_len + 1``)
+    that window positions past ``max_len`` land in and no valid query ever
+    attends (the causal mask covers rows ``<= pos`` and live positions are
+    ``< max_len``); on a plain ``rows = max_len`` cache the clamp is an
+    index identity, preserving the pre-sampling program bit-for-bit.
 
     With a model ``mesh`` the residual stream is pinned REPLICATED at
     every block boundary while the KV cache and the attention math stay
     sharded over heads — per-head contractions never cross shards, so the
-    sharded loop emits the same greedy tokens as the single-chip one
+    sharded loop emits the same tokens as the single-chip one
     (docs/serving.md "Model-parallel replicas")."""
     import jax.numpy as jnp
     import jax
@@ -74,17 +130,17 @@ def _build_decode_fn(num_layers, num_heads, mesh=None):
         def edge(x):
             return x
 
-    def decode_fn(cache, params, tokens, pos):
-        ck, cv = cache["k"], cache["v"]
+    def token_pass(ck, cv, params, tokens, pos):
         nslots = tokens.shape[0]
+        rows = ck.shape[3]
+        wpos = jnp.minimum(pos, jnp.int32(rows - 1))
         x = edge(params["tok_embed_weight"][tokens]
-                 + params["pos_embed_weight"][pos])
+                 + params["pos_embed_weight"][wpos])
         embed = x.shape[1]
         d = embed // num_heads
         scale = jnp.float32(1.0 / float(np.sqrt(d)))
         sidx = jnp.arange(nslots)
-        maxlen = ck.shape[3]
-        tmask = jnp.arange(maxlen)[None, None, :] <= pos[:, None, None]
+        tmask = jnp.arange(rows)[None, None, :] <= pos[:, None, None]
         neg = jnp.float32(-1e30)
         for i in range(num_layers):
             pre = "layer%d" % i
@@ -93,8 +149,8 @@ def _build_decode_fn(num_layers, num_heads, mesh=None):
                 + params[pre + "_attn_qkv_bias"]
             qkv = qkv.reshape(nslots, 3, num_heads, d)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]     # (slots, H, D)
-            ck = ck.at[i, sidx, :, pos, :].set(k)
-            cv = cv.at[i, sidx, :, pos, :].set(v)
+            ck = ck.at[i, sidx, :, wpos, :].set(k)
+            cv = cv.at[i, sidx, :, wpos, :].set(v)
             s = jnp.einsum("shd,shtd->sht", q, ck[i]) * scale
             s = jnp.where(tmask, s, neg)
             w = jax.nn.softmax(s, axis=-1)
@@ -111,31 +167,118 @@ def _build_decode_fn(num_layers, num_heads, mesh=None):
             x = edge(x + f)
         x = _ln(x, params["final_ln_gamma"], params["final_ln_beta"])
         logits = x @ params["lm_head_weight"].T + params["lm_head_bias"]
-        return {"k": ck, "v": cv}, logits
+        return ck, cv, logits
+
+    return token_pass
+
+
+def _build_decode_fn(num_layers, num_heads, mesh=None):
+    """The single-token decode body: one position per slot, sampled
+    in-graph. Returns ``(state, next_tokens)`` — the host reads back one
+    (slots,) int32 vector, never the logits."""
+    token_pass = _build_token_pass(num_layers, num_heads, mesh=mesh)
+
+    def decode_fn(state, params, tokens, pos, temp, top_k, top_p,
+                  fresh_seed, reseed):
+        import jax.numpy as jnp
+        seeds = jnp.where(reseed, fresh_seed, state["seed"])
+        p = dequant_tree(params)
+        ck, cv, logits = token_pass(state["k"], state["v"], p, tokens, pos)
+        u = position_uniforms(seeds, pos)
+        nxt = sample_rows(logits, u, temp, top_k, top_p)
+        return {"k": ck, "v": cv, "seed": seeds}, nxt
 
     return decode_fn
 
 
-class GenerateFuture(object):
-    """Handle for one in-flight sequence; :meth:`result` blocks."""
+def _build_verify_fn(num_layers, num_heads, window, mesh=None):
+    """The speculative verify body: ``window`` positions per slot through
+    the SAME per-position pass as the single-token body, unrolled (the
+    cache threads through, so position j attends the rows j' < j wrote),
+    each position sampled with its own (seed, position) uniform. One
+    dispatch scores and samples the whole window."""
+    token_pass = _build_token_pass(num_layers, num_heads, mesh=mesh)
 
-    __slots__ = ("prompt", "max_new", "event", "tokens", "error", "_loop",
-                 "rid")
+    def verify_fn(state, params, tokens_w, pos0, temp, top_k, top_p,
+                  fresh_seed, reseed):
+        import jax.numpy as jnp
+        seeds = jnp.where(reseed, fresh_seed, state["seed"])
+        p = dequant_tree(params)
+        ck, cv = state["k"], state["v"]
+        outs = []
+        for j in range(window):
+            pos_j = pos0 + jnp.int32(j)
+            ck, cv, logits = token_pass(ck, cv, p, tokens_w[:, j], pos_j)
+            u = position_uniforms(seeds, pos_j)
+            outs.append(sample_rows(logits, u, temp, top_k, top_p))
+        return ({"k": ck, "v": cv, "seed": seeds},
+                jnp.stack(outs, axis=1))
 
-    def __init__(self, loop, prompt, max_new):
+    return verify_fn
+
+
+def _build_extract_fn(mesh=None):
+    """Prefix harvest: copy one slot's full KV slab out of the cache
+    (non-donating — the cache keeps serving). Garbage rows past the
+    prefix length ride along; every consumer rewrites them before any
+    query can attend them."""
+    def extract_fn(state, slot):
+        pk = state["k"][:, slot]
+        pv = state["v"][:, slot]
+        if mesh is not None:
+            import jax
+            from ..parallel.mesh import AXIS_MODEL
+            sh = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(None, AXIS_MODEL))
+            pk = jax.lax.with_sharding_constraint(pk, sh)
+            pv = jax.lax.with_sharding_constraint(pv, sh)
+        return {"k": pk, "v": pv}
+
+    return extract_fn
+
+
+def _build_implant_fn():
+    """Prefix reuse: write a cached KV slab into one slot (the state is
+    donated — in-place on device); seeds pass through untouched."""
+    def implant_fn(state, slot, pk, pv):
+        return {"k": state["k"].at[:, slot].set(pk),
+                "v": state["v"].at[:, slot].set(pv),
+                "seed": state["seed"]}
+
+    return implant_fn
+
+
+class GenerateFuture(Settleable):
+    """Handle for one in-flight sequence; :meth:`result` blocks. Rides
+    the batcher's :class:`~mxnet_tpu.serving.batcher.Settleable` protocol
+    (first settle wins, ``on_done`` fires exactly once after the event),
+    so open-loop clients can drive ``generate`` exactly like ``infer``."""
+
+    __slots__ = ("prompt", "max_new", "_loop", "rid", "temperature",
+                 "top_k", "top_p", "seed", "prefix_len")
+
+    def __init__(self, loop, prompt, max_new, temperature=0.0, top_k=0,
+                 top_p=1.0, seed=None, prefix_len=0, on_done=None):
+        super().__init__(on_done=on_done)
         self.prompt = list(prompt)
         self.max_new = int(max_new)
-        self.event = threading.Event()
-        self.tokens = None
-        self.error = None
         self._loop = loop
         #: serving correlation id (docs/observability.md): shares the
         #: batcher's process-wide sequence so fleet + decode spans never
         #: collide on an id
         self.rid = next(REQUEST_IDS)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        #: RNG stream id: an unseeded request draws a per-request stream
+        #: from its rid (deterministic within a process, distinct across
+        #: requests); pass ``seed=`` for replayable sampling
+        self.seed = int(self.rid if seed is None else seed) & 0x7FFFFFFF
+        self.prefix_len = int(prefix_len)
 
-    def done(self):
-        return self.event.is_set()
+    @property
+    def tokens(self):
+        return self.value
 
     def result(self, timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -146,24 +289,24 @@ class GenerateFuture(object):
             # clean close that raced our enqueue)
             stopped = (self._loop.dead is not None or self._loop._closed
                        or not self._loop._thread.is_alive())
-            if stopped and not self.event.is_set():
-                self.error = ServingClosedError(
+            if stopped and not self.done():
+                self.fail(ServingClosedError(
                     "decode loop died with the sequence in flight: %s"
                     % (self._loop.dead,)
                     if self._loop.dead is not None else
-                    "decode loop closed with the sequence unserved")
-                self.event.set()
+                    "decode loop closed with the sequence unserved"))
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise MXNetError("generate: timed out after %.1fs"
                                  % timeout)
         if self.error is not None:
             raise self.error
-        return self.tokens
+        return self.value
 
 
 class _Slot(object):
-    __slots__ = ("fut", "pending", "pos", "next_token", "emitted")
+    __slots__ = ("fut", "pending", "pos", "next_token", "emitted",
+                 "reseed", "producing")
 
     def __init__(self, fut):
         self.fut = fut
@@ -171,6 +314,8 @@ class _Slot(object):
         self.pos = 0                      # next cache write position
         self.next_token = self.pending.pop(0)
         self.emitted = []
+        self.reseed = True                # seed lands in-state next step
+        self.producing = None             # (key, L): harvest prefix at L
 
 
 class DecodeLoop(object):
@@ -178,13 +323,22 @@ class DecodeLoop(object):
     set (``models/transformer.py`` naming: ``tok_embed_weight``,
     ``layer{i}_...``, ``final_ln_*``, ``lm_head_*``).
 
-    ``generate(prompt, max_new_tokens)`` returns a :class:`GenerateFuture`;
-    sequences join a free slot as soon as one retires — the decode body
-    never stops for a new arrival.
+    ``generate(prompt, max_new_tokens, temperature=..., top_k=...,
+    top_p=..., seed=..., prefix_len=...)`` returns a
+    :class:`GenerateFuture`; sequences join a free slot as soon as one
+    retires — the decode body never stops for a new arrival.
+
+    Decode knobs resolve arg > ``MXTPU_SERVE_*`` env > tuning DB >
+    default (docs/autotune.md): ``spec_k`` (0 = off; needs
+    ``draft_params``), ``prefix_cache`` (default on), ``quantize``
+    (default ``"none"``).
     """
 
     def __init__(self, params, num_layers, num_heads, max_len, slots=4,
-                 eos_id=None, health=None, name=None, contexts=None):
+                 eos_id=None, health=None, name=None, contexts=None,
+                 quantize=None, prefix_cache=None, spec_k=None,
+                 draft_params=None, draft_num_layers=None,
+                 draft_num_heads=None):
         import jax
         import jax.numpy as jnp
         from .. import tracecheck as _tc
@@ -208,35 +362,23 @@ class DecodeLoop(object):
                     "the KV cache shards over heads" % (self.num_heads,
                                                         nshard))
 
-        def _place_param(arr):
-            if self._mesh is None:
-                return arr
-            from ..parallel import placement as _pl
-            from ..parallel.mesh import AXIS_MODEL
-            spec = _pl.auto_spec(AXIS_MODEL, tuple(arr.shape), self._mesh,
-                                 prefer_first=True)
-            return jax.device_put(arr, jax.sharding.NamedSharding(
-                self._mesh, spec or jax.sharding.PartitionSpec()))
-
-        self._params = {}
+        host_params = {}
         for k, v in params.items():
-            data = getattr(v, "data", v)
-            self._params[k] = _place_param(
-                jnp.asarray(np.asarray(data, np.float32)))
+            host_params[k] = np.asarray(getattr(v, "data", v), np.float32)
         for need in ("tok_embed_weight", "pos_embed_weight",
                      "final_ln_gamma", "lm_head_weight", "lm_head_bias"):
-            if need not in self._params:
+            if need not in host_params:
                 raise MXNetError(
                     "DecodeLoop: params missing %r — expected the "
                     "models/transformer.py parameter naming" % need)
-        vocab, embed = self._params["tok_embed_weight"].shape
+        vocab, embed = host_params["tok_embed_weight"].shape
         if embed % self.num_heads:
             raise MXNetError("DecodeLoop: embed %d %% num_heads %d != 0"
                              % (embed, self.num_heads))
         # jit-mode gather CLAMPS out-of-range indices: a position past the
         # embedding table would silently reuse its last row (wrong tokens,
         # zero errors) — fail loudly at construction instead
-        pos_rows = int(self._params["pos_embed_weight"].shape[0])
+        pos_rows = int(host_params["pos_embed_weight"].shape[0])
         if self.max_len > pos_rows:
             raise MXNetError(
                 "DecodeLoop: max_len %d exceeds the positional embedding "
@@ -244,40 +386,133 @@ class DecodeLoop(object):
                 "clamped" % (self.max_len, pos_rows))
         self.vocab_size = int(vocab)
         head_dim = embed // self.num_heads
-        cache_shape = (self.num_layers, self.slots, self.num_heads,
-                       self.max_len, head_dim)
-        self._cache = {"k": jnp.zeros(cache_shape, np.float32),
-                       "v": jnp.zeros(cache_shape, np.float32)}
-        if self._mesh is not None:
-            from ..parallel.mesh import AXIS_MODEL
-            cache_sh = jax.sharding.NamedSharding(
-                self._mesh,
-                jax.sharding.PartitionSpec(None, None, AXIS_MODEL))
-            self._cache = {k: jax.device_put(v, cache_sh)
-                           for k, v in self._cache.items()}
 
+        self._resolve_knobs(host_params, quantize, prefix_cache, spec_k,
+                            draft_params)
+        self.prefix_max = env_int("MXTPU_SERVE_PREFIX_MAX", 8)
+
+        self._params = {
+            k: self._place_leaf(v)
+            for k, v in quantize_tree(host_params, self.quant_mode).items()}
+
+        # --- draft model (speculative decoding only) ------------------
+        self._draft_params = None
+        self.draft_num_layers = self.draft_num_heads = 0
+        if self.spec_k:
+            dhost = {k: np.asarray(getattr(v, "data", v), np.float32)
+                     for k, v in draft_params.items()}
+            if draft_num_layers is None:
+                ids = [int(k[5:k.index("_", 5)]) for k in dhost
+                       if k.startswith("layer")]
+                draft_num_layers = max(ids) + 1 if ids else 0
+            self.draft_num_layers = int(draft_num_layers)
+            self.draft_num_heads = int(draft_num_heads or self.num_heads)
+            if self.draft_num_layers <= 0:
+                raise MXNetError(
+                    "DecodeLoop: draft_params has no layer{i}_* entries")
+            for need in ("tok_embed_weight", "pos_embed_weight",
+                         "final_ln_gamma", "lm_head_weight"):
+                if need not in dhost:
+                    raise MXNetError(
+                        "DecodeLoop: draft_params missing %r" % need)
+            dvocab, dembed = dhost["tok_embed_weight"].shape
+            if int(dvocab) != self.vocab_size:
+                raise MXNetError(
+                    "DecodeLoop: draft vocab %d != target vocab %d — "
+                    "draft proposals must be target token ids"
+                    % (dvocab, self.vocab_size))
+            if dembed % self.draft_num_heads:
+                raise MXNetError(
+                    "DecodeLoop: draft embed %d %% draft_num_heads %d "
+                    "!= 0" % (dembed, self.draft_num_heads))
+            if self._mesh is not None \
+                    and self.draft_num_heads % int(self._mesh.devices.size):
+                raise MXNetError(
+                    "DecodeLoop: draft_num_heads %d %% %d model shards "
+                    "!= 0" % (self.draft_num_heads,
+                              int(self._mesh.devices.size)))
+            if self.max_len > int(dhost["pos_embed_weight"].shape[0]):
+                raise MXNetError(
+                    "DecodeLoop: max_len %d exceeds the DRAFT positional "
+                    "embedding table (%d rows)"
+                    % (self.max_len, dhost["pos_embed_weight"].shape[0]))
+            self._draft_params = {
+                k: self._place_leaf(v)
+                for k, v in quantize_tree(dhost, self.quant_mode).items()}
+
+        # --- device state: KV cache(s) + per-slot seeds ---------------
+        # speculative windows run past a retiring sequence's last row;
+        # one extra TRASH row absorbs those writes (see _build_token_pass)
+        self._rows = self.max_len + (1 if self.spec_k else 0)
+        self._state = self._init_state(self.num_layers, self.num_heads,
+                                       head_dim)
+        self._draft_state = None
+        if self.spec_k:
+            self._draft_state = self._init_state(
+                self.draft_num_layers, self.draft_num_heads,
+                int(dhost["tok_embed_weight"].shape[1])
+                // self.draft_num_heads)
+
+        # --- AOT-compile + register every program ---------------------
         self.name = _tc.unique_name(name or "serving-decode")
-        jfn = jax.jit(_build_decode_fn(self.num_layers, self.num_heads,
-                                       mesh=self._mesh),
-                      donate_argnums=(0,))
-        structs = self._structs(jax)
-        # AOT: the decode body compiles at LOAD time and registers with the
-        # static analyzer — the decode program rides the same gate as the
-        # bucket programs (donation of the cache included)
-        self._compiled = jfn.lower(*structs).compile()
-        self._jfn = jfn   # keep alive: the registry holds only a weakref
-        _tc.register_program(
-            "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
-                                          self.max_len),
-            jfn, structs, donate_argnums=(0,))
-        # MXTPU_MEMCHECK / MXTPU_COMMSCHECK: audit the decode body's
-        # memory and (when sharded) collective inventory at LOAD time —
-        # the KV cache is the dominant buffer and scales with
-        # slots*max_len, so a misconfigured loop fails here, not mid-fleet
+        self._jfns = []
+        self._programs = {}
+
+        def compile_one(tag, fn, structs, donate):
+            jfn = jax.jit(fn, donate_argnums=donate)
+            compiled = jfn.lower(*structs).compile()
+            pname = "%s/%s" % (self.name, tag)
+            _tc.register_program(pname, jfn, structs,
+                                 donate_argnums=donate)
+            self._jfns.append(jfn)   # registry holds only a weakref
+            self._programs[pname] = (compiled, structs, donate)
+            return compiled
+
+        samp = self._sampling_structs(jax)
+        state_s = self._tree_structs(jax, self._state)
+        params_s = self._tree_structs(jax, self._params)
+        if self.spec_k:
+            window = self.spec_k + 1
+            dstate_s = self._tree_structs(jax, self._draft_state)
+            dparams_s = self._tree_structs(jax, self._draft_params)
+            tokw_s = self._vec_struct(jax, (self.slots, window), np.int32)
+            self._verify_c = compile_one(
+                "verify[slots=%d,win=%d]" % (self.slots, window),
+                _build_verify_fn(self.num_layers, self.num_heads, window,
+                                 mesh=self._mesh),
+                (state_s, params_s, tokw_s) + samp[1:], (0,))
+            self._jfn = self._jfns[-1]   # the main decode body
+            self._draft_c = compile_one(
+                "draft[slots=%d,len=%d]" % (self.slots, self.max_len),
+                _build_decode_fn(self.draft_num_layers,
+                                 self.draft_num_heads, mesh=self._mesh),
+                (dstate_s, dparams_s) + samp, (0,))
+        else:
+            self._step_c = compile_one(
+                "step[slots=%d,len=%d]" % (self.slots, self.max_len),
+                _build_decode_fn(self.num_layers, self.num_heads,
+                                 mesh=self._mesh),
+                (state_s, params_s) + samp, (0,))
+            self._jfn = self._jfns[-1]   # the main decode body
+        if self.prefix_enabled:
+            slot_s = self._vec_struct(jax, (), np.int32)
+            self._prefix_programs(compile_one, jax, "target", state_s,
+                                  slot_s)
+            if self.spec_k:
+                self._prefix_programs(compile_one, jax, "draft", dstate_s,
+                                      slot_s)
+
+        # MXTPU_MEMCHECK / MXTPU_COMMSCHECK: audit the whole decode
+        # program set at LOAD time — memory_report() covers every program
+        # above, so the resident-set lint prices the draft+target pair
+        # (and the KV caches, the dominant buffers) before any traffic
         from .engine import _audit_load_memory, _audit_load_comms
         _audit_load_memory(self, "DecodeLoop")
         _audit_load_comms(self, "DecodeLoop")
 
+        #: device-resident prefix registry: key (the prefix token tuple)
+        #: -> {"len", "target": {k,v}, "draft": {k,v}|None}, LRU-bounded
+        self._prefix = collections.OrderedDict()
         self._join_q = queue.Queue()
         self._slots = [None] * self.slots
         self._closed = False
@@ -289,37 +524,204 @@ class DecodeLoop(object):
                                         daemon=True)
         self._thread.start()
 
-    def _structs(self, jax):
-        def sds(x):
-            sh = getattr(x, "sharding", None)
-            if (self._mesh is not None
-                    and isinstance(sh, jax.sharding.NamedSharding)):
-                return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
-                                            sharding=sh)
-            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
-        cache_s = {k: sds(v) for k, v in self._cache.items()}
-        params_s = {k: sds(v) for k, v in self._params.items()}
-        repl = None
+    # ------------------------------------------------------------------
+    def _resolve_knobs(self, host_params, quantize, prefix_cache, spec_k,
+                       draft_params):
+        """arg > MXTPU_SERVE_* env > tuning DB > default. A DB-resolved
+        ``spec_k`` without a draft model falls back with a warning (a
+        stale DB row must not break a deploy); an arg/env one raises."""
+        self.quant_mode = resolve_mode(
+            quantize if quantize is not None
+            else env_str("MXTPU_SERVE_QUANT", "none"))
+
+        db = {}
+        if spec_k is None and not env_str("MXTPU_SERVE_SPEC_K") \
+                or prefix_cache is None \
+                and not env_str("MXTPU_SERVE_PREFIX_CACHE"):
+            try:
+                from .. import autotune as _at
+                if _at.enabled():
+                    db = _at.resolve_decode_knobs(host_params) or {}
+            except Exception as e:
+                logging.warning("DecodeLoop: tuning-DB resolution failed "
+                                "(%r) — using defaults", e)
+
+        src = "default"
+        if spec_k is not None:
+            self.spec_k, src = int(spec_k), "arg"
+        elif env_str("MXTPU_SERVE_SPEC_K"):
+            self.spec_k, src = env_int("MXTPU_SERVE_SPEC_K", 0), "env"
+        elif "spec_k" in db:
+            self.spec_k, src = int(db["spec_k"]), "db"
+        else:
+            self.spec_k = 0
+        if self.spec_k < 0:
+            raise MXNetError("DecodeLoop: spec_k must be >= 0, got %d"
+                             % self.spec_k)
+        if self.spec_k and draft_params is None:
+            if src == "db":
+                logging.warning(
+                    "DecodeLoop: tuning DB resolved spec_k=%d but no "
+                    "draft_params were given — speculative decoding "
+                    "disabled", self.spec_k)
+                self.spec_k = 0
+            else:
+                raise MXNetError(
+                    "DecodeLoop: spec_k=%d (%s) needs draft_params — "
+                    "speculative decoding drafts through a small "
+                    "co-resident model" % (self.spec_k, src))
+
+        if prefix_cache is not None:
+            self.prefix_enabled = bool(prefix_cache)
+        elif env_str("MXTPU_SERVE_PREFIX_CACHE"):
+            self.prefix_enabled = env_str("MXTPU_SERVE_PREFIX_CACHE") \
+                .lower() not in ("0", "false", "off", "no")
+        elif "prefix_cache" in db:
+            self.prefix_enabled = bool(int(db["prefix_cache"]))
+        else:
+            self.prefix_enabled = True
+
+    def _place_leaf(self, leaf):
+        """Place one stored parameter leaf (array or int8 ``{"q","s"}``
+        pair). Sharded loops shard the int8 payload by the placement rule
+        and pin the per-channel scale along the SAME axis-0 split, so
+        each chip holds 1/N of the quantized bytes."""
+        import jax
+        import jax.numpy as jnp
+        if self._mesh is None:
+            if is_quantized_leaf(leaf):
+                return {"q": jnp.asarray(leaf["q"]),
+                        "s": jnp.asarray(leaf["s"])}
+            return jnp.asarray(leaf)
+        from ..parallel import placement as _pl
+        from ..parallel.mesh import AXIS_MODEL
+
+        def put(arr, spec):
+            return jax.device_put(arr, jax.sharding.NamedSharding(
+                self._mesh, spec or jax.sharding.PartitionSpec()))
+
+        if is_quantized_leaf(leaf):
+            spec = _pl.auto_spec(AXIS_MODEL, tuple(leaf["q"].shape),
+                                 self._mesh, prefer_first=True)
+            s_spec = None
+            if spec is not None and len(spec) and spec[0]:
+                s_spec = jax.sharding.PartitionSpec(spec[0])
+            return {"q": put(leaf["q"], spec), "s": put(leaf["s"], s_spec)}
+        spec = _pl.auto_spec(AXIS_MODEL, tuple(leaf.shape), self._mesh,
+                             prefer_first=True)
+        return put(leaf, spec)
+
+    def _init_state(self, layers, heads, head_dim):
+        import jax
+        import jax.numpy as jnp
+        cache_shape = (layers, self.slots, heads, self._rows, head_dim)
+        state = {"k": jnp.zeros(cache_shape, np.float32),
+                 "v": jnp.zeros(cache_shape, np.float32),
+                 "seed": jnp.zeros((self.slots,), np.uint32)}
+        if self._mesh is not None:
+            from ..parallel.mesh import AXIS_MODEL
+            cache_sh = jax.sharding.NamedSharding(
+                self._mesh,
+                jax.sharding.PartitionSpec(None, None, AXIS_MODEL))
+            repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+            state = {"k": jax.device_put(state["k"], cache_sh),
+                     "v": jax.device_put(state["v"], cache_sh),
+                     "seed": jax.device_put(state["seed"], repl)}
+        return state
+
+    def _prefix_programs(self, compile_one, jax, which, state_s, slot_s):
+        shape = tuple(state_s["k"].shape)
+        slab_shape = (shape[0],) + shape[2:]
+        if self._mesh is not None:
+            from ..parallel.mesh import AXIS_MODEL
+            sh = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec(None, AXIS_MODEL))
+            slab_s = jax.ShapeDtypeStruct(slab_shape, np.float32,
+                                          sharding=sh)
+        else:
+            slab_s = jax.ShapeDtypeStruct(slab_shape, np.float32)
+        get_c = compile_one("prefix_get[%s]" % which,
+                            _build_extract_fn(mesh=self._mesh),
+                            (state_s, slot_s), ())
+        put_c = compile_one("prefix_put[%s]" % which, _build_implant_fn(),
+                            (state_s, slot_s, slab_s, slab_s), (0,))
+        if which == "target":
+            self._extract_c, self._implant_c = get_c, put_c
+        else:
+            self._extract_draft_c, self._implant_draft_c = get_c, put_c
+
+    # ------------------------------------------------------------------
+    def _sds(self, jax, x):
+        sh = getattr(x, "sharding", None)
+        if (self._mesh is not None
+                and isinstance(sh, jax.sharding.NamedSharding)):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                        sharding=sh)
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+
+    def _tree_structs(self, jax, tree):
+        out = {}
+        for k, v in tree.items():
+            if is_quantized_leaf(v):
+                out[k] = {"q": self._sds(jax, v["q"]),
+                          "s": self._sds(jax, v["s"])}
+            else:
+                out[k] = self._sds(jax, v)
+        return out
+
+    def _vec_struct(self, jax, shape, dtype):
         if self._mesh is not None:
             repl = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec())
-        if repl is not None:
-            tok_s = jax.ShapeDtypeStruct((self.slots,), np.int32,
-                                         sharding=repl)
-            pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32,
-                                         sharding=repl)
-        else:
-            tok_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
-            pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
-        return cache_s, params_s, tok_s, pos_s
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=repl)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    def _sampling_structs(self, jax):
+        """(tokens, pos, temp, top_k, top_p, fresh_seed, reseed)."""
+        n = (self.slots,)
+        return (self._vec_struct(jax, n, np.int32),
+                self._vec_struct(jax, n, np.int32),
+                self._vec_struct(jax, n, np.float32),
+                self._vec_struct(jax, n, np.int32),
+                self._vec_struct(jax, n, np.float32),
+                self._vec_struct(jax, n, np.uint32),
+                self._vec_struct(jax, n, np.bool_))
+
+    def _dev(self, arrs):
+        if self._mesh is None:
+            import jax.numpy as jnp
+            return [jnp.asarray(a) for a in arrs]
+        import jax
+        repl = jax.sharding.NamedSharding(self._mesh,
+                                          jax.sharding.PartitionSpec())
+        return [jax.device_put(a, repl) for a in arrs]
+
+    def _dev_scalar(self, i):
+        return self._dev([np.int32(i)])[0]
+
+    # ------------------------------------------------------------------
+    def weight_bytes(self):
+        """Resident HBM bytes of the (possibly quantized) parameter
+        set(s) — target plus draft; GLOBAL across shards (a fully
+        sharded loop holds 1/N of this per chip). The memcheck HBM win
+        the int8 leg is gated on (docs/serving.md "Quantized
+        weights")."""
+        total = tree_bytes(self._params)
+        if self._draft_params is not None:
+            total += tree_bytes(self._draft_params)
+        return total
 
     # ------------------------------------------------------------------
     def update_params(self, params):
-        """Hot-reload the LM parameter set under the RUNNING loop with
-        zero recompiles (train-to-serve handoff, docs/serving.md "Hot
-        reload"): the decode body takes params per call and only the KV
-        cache is donated, so swapping the dict re-binds the next step's
-        arguments without touching the compiled executable.
+        """Hot-reload the TARGET parameter set under the RUNNING loop
+        with zero recompiles (train-to-serve handoff, docs/serving.md
+        "Hot reload"): the decode body takes params per call and only the
+        state is donated, so swapping the dict re-binds the next step's
+        arguments without touching the compiled executable. Under a
+        quantized loop the incoming f32 checkpoint is re-quantized
+        host-side first. (The draft model is fixed at construction —
+        rebuild the loop to swap drafts.)
 
         Every resident parameter must arrive with its exact shape; new
         arrays land with the resident arrays' shardings (the AOT
@@ -330,7 +732,6 @@ class DecodeLoop(object):
         entries from the old weights — the standard continuous-batching
         reload semantics; retire slots first for a clean cut)."""
         import jax
-        import jax.numpy as jnp
         missing = sorted(set(self._params) - set(params))
         if missing:
             raise MXNetError(
@@ -340,34 +741,56 @@ class DecodeLoop(object):
                 % ", ".join(missing[:8]))
         new = {}
         for n, resident in self._params.items():
-            arr = jnp.asarray(np.asarray(getattr(params[n], "data",
-                                                 params[n]), np.float32))
-            if tuple(arr.shape) != tuple(resident.shape):
+            arr = np.asarray(getattr(params[n], "data", params[n]),
+                             np.float32)
+            rq = resident["q"] if is_quantized_leaf(resident) else resident
+            if tuple(arr.shape) != tuple(rq.shape):
                 raise MXNetError(
                     "update_params: %r shape %s does not match the "
                     "compiled decode body's %s — rebuild the loop for a "
                     "different architecture"
-                    % (n, tuple(arr.shape), tuple(resident.shape)))
-            sh = getattr(resident, "sharding", None)
-            new[n] = jax.device_put(arr, sh) if sh is not None else arr
+                    % (n, tuple(arr.shape), tuple(rq.shape)))
+            stored = quantize_array(arr, self.quant_mode)
+            if is_quantized_leaf(resident):
+                new[n] = {
+                    "q": jax.device_put(stored["q"],
+                                        resident["q"].sharding),
+                    "s": jax.device_put(stored["s"],
+                                        resident["s"].sharding)}
+            else:
+                sh = getattr(resident, "sharding", None)
+                new[n] = jax.device_put(np.asarray(stored, rq.dtype), sh) \
+                    if sh is not None else jax.numpy.asarray(
+                        np.asarray(stored, rq.dtype))
         # land transfers BEFORE the rebind so the decode thread never
         # blocks on (or races) an in-flight H2D mid-step
         for v in new.values():
-            v.block_until_ready()
+            if is_quantized_leaf(v):
+                v["q"].block_until_ready()
+                v["s"].block_until_ready()
+            else:
+                v.block_until_ready()
         self._params = new
         from ..obs import REGISTRY
         REGISTRY.counter(
             "serving.param_reloads",
             "parameter hot-reloads into live serving engines").inc()
         _obs.instant("decode_param_reload", params=len(new))
-        import logging
-        logging.info("%s: hot-reloaded %d parameters (zero recompiles)",
-                     self.name, len(new))
+        logging.info("%s: hot-reloaded %d parameters (zero recompiles, "
+                     "quantize=%s)", self.name, len(new), self.quant_mode)
 
     # ------------------------------------------------------------------
-    def generate(self, prompt, max_new_tokens):
+    def generate(self, prompt, max_new_tokens, temperature=0.0, top_k=0,
+                 top_p=1.0, seed=None, prefix_len=0):
         """Queue one sequence; returns a :class:`GenerateFuture` whose
-        ``result()`` is the list of generated token ids."""
+        ``result()`` is the list of generated token ids.
+
+        ``temperature=0`` (the default) is bitwise greedy decoding;
+        ``temperature>0`` samples through the in-graph
+        top-k/top-p/inverse-CDF path, deterministically per ``seed``.
+        ``prefix_len=L`` declares ``prompt[:L]`` a shared prefix for the
+        KV prefix cache (first use prefills and stores it; later joins
+        implant the cached slab and skip those L steps)."""
         if self.dead is not None or self._closed:
             raise ServingClosedError(
                 "decode loop is not running (%s)"
@@ -387,7 +810,17 @@ class DecodeLoop(object):
                 "generate: prompt (%d) + max_new_tokens (%d) exceeds the "
                 "cache length %d" % (len(prompt), max_new_tokens,
                                      self.max_len))
-        fut = GenerateFuture(self, prompt, max_new_tokens)
+        temperature, top_k, top_p = validate_sampling(
+            temperature, top_k, top_p)
+        prefix_len = int(prefix_len)
+        if prefix_len < 0 or prefix_len >= len(prompt):
+            raise MXNetError(
+                "generate: prefix_len %d must be in [0, len(prompt)=%d) — "
+                "at least one prompt token must follow the shared prefix"
+                % (prefix_len, len(prompt)))
+        fut = GenerateFuture(self, prompt, max_new_tokens,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, seed=seed, prefix_len=prefix_len)
         self._join_q.put(fut)
         self._wake.set()
         _obs.instant("decode_submit", req=fut.rid, prompt_len=len(prompt),
@@ -407,15 +840,13 @@ class DecodeLoop(object):
         shed = 0
         for i, slot in enumerate(self._slots):
             if slot is not None:
-                slot.fut.error = exc
-                slot.fut.event.set()
+                slot.fut.fail(exc)
                 self._slots[i] = None
                 shed += 1
         while True:
             try:
                 fut = self._join_q.get_nowait()
-                fut.error = exc
-                fut.event.set()
+                fut.fail(exc)
                 shed += 1
             except queue.Empty:
                 break
@@ -430,9 +861,57 @@ class DecodeLoop(object):
                 fut = self._join_q.get_nowait()
             except queue.Empty:
                 return
-            self._slots[i] = _Slot(fut)
+            slot = _Slot(fut)
+            self._slots[i] = slot
+            if self.prefix_enabled and fut.prefix_len > 0:
+                key = tuple(fut.prompt[:fut.prefix_len])
+                entry = self._prefix.get(key)
+                if entry is not None:
+                    self._prefix.move_to_end(key)
+                    self._implant_slot(i, entry)
+                    slot.pos = entry["len"]
+                    slot.pending = list(fut.prompt[entry["len"]:])
+                    slot.next_token = slot.pending.pop(0)
+                    self.health.record_prefix_hit()
+                    _obs.instant("decode_prefix_hit", req=fut.rid, slot=i,
+                                 plen=entry["len"])
+                else:
+                    slot.producing = (key, fut.prefix_len)
             _obs.instant("decode_join", req=fut.rid, slot=i)
             self.health.record_join()
+
+    def _implant_slot(self, i, entry):
+        s = self._dev_scalar(i)
+        t = entry["target"]
+        self._state = self._implant_c(self._state, s, t["k"], t["v"])
+        if self.spec_k and entry["draft"] is not None:
+            d = entry["draft"]
+            self._draft_state = self._implant_draft_c(
+                self._draft_state, s, d["k"], d["v"])
+
+    def _maybe_harvest(self, i):
+        """Prefix-cache producer path: once this slot has teacher-forced
+        past its declared prefix, copy the slab out and publish it."""
+        slot = self._slots[i]
+        if slot is None or slot.producing is None:
+            return
+        key, plen = slot.producing
+        if slot.pos < plen:
+            return
+        slot.producing = None
+        if key in self._prefix:        # a co-rider raced us to it
+            self._prefix.move_to_end(key)
+            return
+        s = self._dev_scalar(i)
+        slab = self._extract_c(self._state, s)
+        entry = {"len": plen, "target": slab, "draft": None}
+        if self.spec_k:
+            entry["draft"] = self._extract_draft_c(self._draft_state, s)
+        self._prefix[key] = entry
+        while len(self._prefix) > self.prefix_max:
+            self._prefix.popitem(last=False)   # LRU eviction
+        self.health.record_prefix_prefill()
+        _obs.instant("decode_prefix_store", slot=i, plen=plen)
 
     def _run(self):
         from .. import faults as _faults
@@ -460,32 +939,49 @@ class DecodeLoop(object):
             return
 
     def _step(self):
-        import jax.numpy as jnp
         self._steps += 1
         with _obs.span("decode_step", step=self._steps,
                        reqs=[s.fut.rid for s in self._slots
                              if s is not None]):
-            self._step_inner(jnp)
+            if self.spec_k:
+                self._step_spec()
+            else:
+                self._step_inner()
 
-    def _step_inner(self, jnp):
-        tokens = np.zeros(self.slots, np.int32)
-        pos = np.zeros(self.slots, np.int32)
+    def _gather_sampling(self):
+        """Host-side per-slot dispatch arrays (and consume reseed marks)."""
+        n = self.slots
+        arrs = {"tokens": np.zeros(n, np.int32),
+                "pos": np.zeros(n, np.int32),
+                "temp": np.zeros(n, np.float32),
+                "top_k": np.zeros(n, np.int32),
+                "top_p": np.ones(n, np.float32),
+                "fresh": np.zeros(n, np.uint32),
+                "reseed": np.zeros(n, np.bool_)}
         for i, slot in enumerate(self._slots):
-            if slot is not None:
-                tokens[i] = slot.next_token
-                pos[i] = slot.pos
-        if self._mesh is None:
-            dev_tokens, dev_pos = jnp.asarray(tokens), jnp.asarray(pos)
-        else:
-            import jax
-            repl = jax.sharding.NamedSharding(
-                self._mesh, jax.sharding.PartitionSpec())
-            dev_tokens = jax.device_put(tokens, repl)
-            dev_pos = jax.device_put(pos, repl)
-        new_cache, logits = self._compiled(
-            self._cache, self._params, dev_tokens, dev_pos)
-        self._cache = new_cache
-        host_logits = np.asarray(logits)   # the one per-step readback
+            if slot is None:
+                continue
+            arrs["tokens"][i] = slot.next_token
+            arrs["pos"][i] = slot.pos
+            arrs["temp"][i] = slot.fut.temperature
+            arrs["top_k"][i] = slot.fut.top_k
+            arrs["top_p"][i] = slot.fut.top_p
+            if slot.reseed:
+                arrs["fresh"][i] = slot.fut.seed
+                arrs["reseed"][i] = True
+                slot.reseed = False
+        return arrs
+
+    def _step_inner(self):
+        from .. import faults as _faults
+        a = self._gather_sampling()
+        _faults.fire("serve.sample")
+        new_state, toks = self._step_c(
+            self._state, self._params,
+            *self._dev([a["tokens"], a["pos"], a["temp"], a["top_k"],
+                        a["top_p"], a["fresh"], a["reseed"]]))
+        self._state = new_state
+        host_toks = np.asarray(toks)   # the one per-step readback
         self.health.record_decode_step()
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -495,7 +991,7 @@ class DecodeLoop(object):
                 # prompt still feeding: next input is teacher-forced
                 slot.next_token = slot.pending.pop(0)
             else:
-                tok = int(np.argmax(host_logits[i]))
+                tok = int(host_toks[i])
                 slot.emitted.append(tok)
                 slot.next_token = tok
                 if (len(slot.emitted) >= slot.fut.max_new
@@ -504,73 +1000,167 @@ class DecodeLoop(object):
                     continue
             if slot.pos >= self.max_len:
                 self._retire(i)
+                continue
+            self._maybe_harvest(i)
+
+    def _step_spec(self):
+        """One draft-K-then-verify round: K+1 cheap draft passes chain
+        the proposals (teacher-forced wherever the prompt already knows
+        the token, so the draft cache stays position-synced), then ONE
+        batched target pass samples every window position; the host
+        replays the window through exactly the single-token accounting,
+        committing samples until the first mismatch with the window's
+        inputs (docs/serving.md "Speculative decoding")."""
+        from .. import faults as _faults
+        window = self.spec_k + 1
+        a = self._gather_sampling()
+        w = np.zeros((self.slots, window), np.int32)
+        w[:, 0] = a["tokens"]
+        dfill = np.zeros((self.slots, window), np.bool_)
+        pend0 = [list(s.pending) if s is not None else []
+                 for s in self._slots]
+        _faults.fire("serve.sample")
+        no_reseed = np.zeros(self.slots, np.bool_)
+        for j in range(window):
+            d_state, d_toks = self._draft_c(
+                self._draft_state, self._draft_params,
+                *self._dev([w[:, j].copy(),
+                            (a["pos"] + j).astype(np.int32), a["temp"],
+                            a["top_k"], a["top_p"], a["fresh"],
+                            a["reseed"] if j == 0 else no_reseed]))
+            self._draft_state = d_state
+            if j + 1 >= window:
+                break
+            d_host = np.asarray(d_toks)
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                if j < len(pend0[i]):
+                    w[i, j + 1] = pend0[i][j]     # prompt knows this one
+                else:
+                    w[i, j + 1] = d_host[i]       # draft proposal
+                    dfill[i, j + 1] = True
+        _faults.fire("serve.spec_verify")
+        new_state, samples = self._verify_c(
+            self._state, self._params,
+            *self._dev([w, a["pos"], a["temp"], a["top_k"], a["top_p"],
+                        a["fresh"], a["reseed"]]))
+        self._state = new_state
+        s = np.asarray(samples)        # (slots, window) int32
+        self.health.record_decode_step()
+        accepted = judged = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            for j in range(window):
+                slot.pos += 1
+                if slot.pending:
+                    nxt = slot.pending.pop(0)
+                else:
+                    tok = int(s[i, j])
+                    slot.emitted.append(tok)
+                    nxt = tok
+                    if (len(slot.emitted) >= slot.fut.max_new
+                            or (self.eos_id is not None
+                                and tok == self.eos_id)):
+                        self._retire(i)
+                        break
+                if slot.pos >= self.max_len:
+                    self._retire(i)
+                    break
+                if j + 1 >= window:
+                    slot.next_token = nxt
+                    break
+                if nxt != int(w[i, j + 1]):
+                    # window diverged from the committed stream: rows past
+                    # slot.pos hold speculative garbage the next round
+                    # rewrites before any query can attend it
+                    if dfill[i, j + 1]:
+                        judged += 1    # proposal reached a verdict: rejected
+                    slot.next_token = nxt
+                    break
+                if dfill[i, j + 1]:
+                    judged += 1
+                    accepted += 1      # draft proposal confirmed
+            if self._slots[i] is not None:
+                self._maybe_harvest(i)
+        # only proposals the target actually RULED ON count: positions a
+        # retire/length break left unverified would deflate the acceptance
+        # rate a perfect draft earns (drafted == accepted by construction)
+        self.health.record_spec_round(judged, accepted)
 
     def _retire(self, i):
         slot = self._slots[i]
         self._slots[i] = None
-        slot.fut.tokens = list(slot.emitted)
-        slot.fut.event.set()
+        slot.fut.fulfill(list(slot.emitted))
         _obs.instant("decode_retire", req=slot.fut.rid, slot=i,
-                     emitted=len(slot.fut.tokens))
+                     emitted=len(slot.emitted))
         self.health.record_retire()
 
     # ------------------------------------------------------------------
     def memory_report(self, top=8):
-        """Static memory profile of the compiled decode body
+        """Static memory profile of EVERY compiled decode program
         (docs/static_analysis.md "Memory lints"): ``{program_name:
-        MemoryReport}`` from the already-compiled executable — the donated
-        KV cache's alias accounting included. An executable that cannot
-        report memory is skipped with a warning (mirrors
+        MemoryReport}`` from the already-compiled executables — donated
+        state alias accounting included, and the draft+target pair (plus
+        the prefix programs) all present so the resident-set lint prices
+        their co-residency. An executable that cannot report memory is
+        skipped with a warning (mirrors
         ``ServingEngine.memory_report``)."""
         from .. import memcheck as _mc
-        import jax
-        import logging
-        name = "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
-                                             self.max_len)
-        try:
-            return {name: _mc.analyze_compiled(
-                self._compiled, name, args=self._structs(jax),
-                donate_argnums=(0,), top=top)}
-        except Exception as e:
-            logging.warning(
-                "DecodeLoop: compiled decode body cannot report memory "
-                "(%s) — skipped from the memory audit", e)
-            return {}
+        reports = {}
+        for name, (comp, structs, donate) in sorted(
+                self._programs.items()):
+            try:
+                reports[name] = _mc.analyze_compiled(
+                    comp, name, args=structs, donate_argnums=donate,
+                    top=top)
+            except Exception as e:
+                logging.warning(
+                    "DecodeLoop: %s cannot report memory (%s) — skipped "
+                    "from the memory audit", name, e)
+        return reports
 
     def comms_report(self):
-        """Static collective inventory of the compiled decode body
+        """Static collective inventory of every compiled decode program
         (``{program_name: CommsReport}``) — the per-token partitioning
         bill of a sharded loop; zero collectives single-chip. Mirrors
         :meth:`ServingEngine.comms_report` (skip-with-warning on
         executables that cannot surface HLO text)."""
         from .. import commscheck as _cc
-        import logging
-        name = "%s/step[slots=%d,len=%d]" % (self.name, self.slots,
-                                             self.max_len)
-        try:
-            return {name: _cc.analyze_compiled(self._compiled, name,
-                                               mesh=self._mesh)}
-        except Exception as e:
-            logging.warning(
-                "DecodeLoop: compiled decode body cannot report its "
-                "collectives (%s) — skipped from the comms audit", e)
-            return {}
+        reports = {}
+        for name, (comp, _structs, _donate) in sorted(
+                self._programs.items()):
+            try:
+                reports[name] = _cc.analyze_compiled(comp, name,
+                                                     mesh=self._mesh)
+            except Exception as e:
+                logging.warning(
+                    "DecodeLoop: %s cannot report its collectives (%s) — "
+                    "skipped from the comms audit", name, e)
+        return reports
 
     def check(self, const_bytes=None, memory=False, budget=None,
               comms=False, min_eff=0.0):
-        """Static-analyze the registered decode program; returns findings
-        (the CI serving gate asserts none — docs/serving.md).
-        ``memory=True`` adds the memory lints over the compiled body;
-        ``comms=True`` the communication lints (``min_eff`` defaults to 0
-        like :meth:`ServingEngine.check` — the efficiency floor is a
-        training-scale gate)."""
+        """Static-analyze the registered decode programs; returns
+        findings (the CI serving gate asserts none — docs/serving.md).
+        ``memory=True`` adds the memory lints over every compiled body
+        plus the ``resident-set`` lint over the whole set — with
+        speculative decoding on, that is the draft+target co-residency
+        audit; ``comms=True`` the communication lints (``min_eff``
+        defaults to 0 like :meth:`ServingEngine.check` — the efficiency
+        floor is a training-scale gate)."""
         from .. import tracecheck as _tc
         findings = _tc.check_registered(const_bytes=const_bytes,
                                         match=self.name + "/")
         if memory:
             from .. import memcheck as _mc
-            for rep in self.memory_report().values():
+            reports = self.memory_report()
+            for rep in reports.values():
                 findings += _mc.lint_report(rep, budget=budget)
+            findings += _mc.lint_resident_set(
+                reports.values(), "%s/resident-set" % self.name,
+                budget=budget)
         if comms:
             from .. import commscheck as _cc
             for rep in self.comms_report().values():

@@ -170,14 +170,26 @@ class ServingEngine(object):
     :meth:`export_compiled`; when it loads cleanly the engine starts with
     ZERO compiles (cold-start-free deploy). A stale/mismatched file logs a
     warning and falls back to fresh AOT compilation.
+
+    ``quantize=`` (or ``MXTPU_SERVE_QUANT``): ``"none"`` (default) |
+    ``"bf16"`` | ``"int8"`` weight-only quantization at load — per-channel
+    scales, dequant inside the compiled body, so memcheck's resident
+    accounting shows the HBM weight-bytes win and a sharded engine holds
+    1/N of the QUANTIZED bytes per chip. Gate quality with
+    :meth:`quality_report` + :func:`mxnet_tpu.serving.quantize.check_quality`
+    (docs/serving.md "Quantized weights").
     """
 
     def __init__(self, symbol_json_or_file, param_file_or_dict, input_shapes,
                  buckets=None, output_names=None, allow_missing=False,
                  input_dtypes=None, executables=None, health=None,
-                 name=None, contexts=None):
+                 name=None, contexts=None, quantize=None):
         import jax
         from .. import tracecheck as _tc
+        from .quantize import resolve_mode
+        self.quant_mode = resolve_mode(
+            quantize if quantize is not None
+            else env_str("MXTPU_SERVE_QUANT", "none"))
         #: model-axis mesh when this engine is bigger than one chip
         #: (``contexts=``): params shard over 'model' per the
         #: parallel.placement first-divisible-dim rule, batch inputs stay
@@ -242,6 +254,8 @@ class ServingEngine(object):
                                 aux_shapes))
         import jax.numpy as jnp
 
+        from .quantize import is_quantized_leaf, quantize_array
+
         def place(arr, sharded):
             """Model-mesh placement: params shard per the placement rule
             (first divisible dim = the OUTPUT dim of an (out, in) weight,
@@ -259,14 +273,41 @@ class ServingEngine(object):
             return jax.device_put(
                 arr, jax.sharding.NamedSharding(self._mesh, spec or P()))
 
+        def store_param(host_arr):
+            """Quantize (per ``quant_mode``) then place one parameter.
+            An int8 leaf becomes ``{"q", "s"}``: the payload shards per
+            the placement rule and the per-channel scale pins along the
+            SAME axis-0 split, so each chip holds 1/N of the quantized
+            bytes beside its own scales."""
+            stored = quantize_array(np.asarray(host_arr), self.quant_mode)
+            if not is_quantized_leaf(stored):
+                return place(jnp.asarray(stored), True)
+            if self._mesh is None:
+                return {"q": jnp.asarray(stored["q"]),
+                        "s": jnp.asarray(stored["s"])}
+            from ..parallel import placement as _pl
+            from ..parallel.mesh import AXIS_MODEL
+            P = jax.sharding.PartitionSpec
+            spec = _pl.auto_spec(AXIS_MODEL, tuple(stored["q"].shape),
+                                 self._mesh, prefer_first=True)
+            s_spec = None
+            if spec is not None and len(spec) and spec[0]:
+                s_spec = P(spec[0])
+            put = lambda a, sp: jax.device_put(
+                a, jax.sharding.NamedSharding(self._mesh, sp or P()))
+            return {"q": put(stored["q"], spec),
+                    "s": put(stored["s"], s_spec)}
+
         def as_dev(v, shape, sharded=True):
             data = getattr(v, "data", v)  # NDArray or raw array
-            arr = jnp.asarray(np.asarray(data))
+            arr = np.asarray(data)
             if tuple(arr.shape) != tuple(shape):
                 raise MXNetError(
                     "ServingEngine: parameter shape %s does not match the "
                     "graph's %s" % (tuple(arr.shape), tuple(shape)))
-            return place(arr, sharded)
+            if sharded:
+                return store_param(arr)
+            return place(jnp.asarray(arr), sharded)
 
         self._params = {}
         for n in self._symbol.list_arguments():
@@ -275,8 +316,8 @@ class ServingEngine(object):
             if n in arg_params:
                 self._params[n] = as_dev(arg_params[n], shape_of[n])
             else:  # allow_missing=True: deliberate zero-fill
-                self._params[n] = place(
-                    jnp.zeros(shape_of[n], np.float32), True)
+                self._params[n] = store_param(
+                    np.zeros(shape_of[n], np.float32))
         self._aux = {}
         for n in self._symbol.list_auxiliary_states():
             if n in aux_params:
@@ -316,9 +357,17 @@ class ServingEngine(object):
         # baked in (well under the const-capture lint threshold)
         key = jax.random.key(0) if needs_rng else None
 
+        qmode = self.quant_mode
+
         def _fwd(params, aux, batch):
+            # weight-only dequant INSIDE the body: the resident arrays
+            # (what memcheck prices) stay int8/bf16; the f32 views are
+            # per-dispatch temporaries. Mode "none" bypasses entirely so
+            # an unquantized engine's program is untouched.
+            from .quantize import dequant_tree
             arg_vals = dict(batch)
-            arg_vals.update(params)
+            arg_vals.update(params if qmode == "none"
+                            else dequant_tree(params))
             outs, _aux_up = run(arg_vals, aux, key, False)
             return tuple(outs)
 
@@ -372,7 +421,10 @@ class ServingEngine(object):
                                             sharding=sh)
             return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
 
-        params_s = {n: sds(v) for n, v in self._params.items()}
+        from .quantize import is_quantized_leaf
+        params_s = {n: ({"q": sds(v["q"]), "s": sds(v["s"])}
+                        if is_quantized_leaf(v) else sds(v))
+                    for n, v in self._params.items()}
         aux_s = {n: sds(v) for n, v in self._aux.items()}
         repl = None
         if self._mesh is not None:
@@ -434,6 +486,8 @@ class ServingEngine(object):
         elif isinstance(arg_params, tuple) and len(arg_params) == 2:
             arg_params, aux_params = arg_params
 
+        from .quantize import is_quantized_leaf, quantize_array
+
         def validated(new, cur, kind):
             missing = sorted(set(cur) - set(new))
             if missing:
@@ -444,8 +498,28 @@ class ServingEngine(object):
                     % (kind, ", ".join(missing)))
             out = {}
             for n, resident in cur.items():
-                arr = jnp.asarray(np.asarray(getattr(new[n], "data",
-                                                     new[n])))
+                host = np.asarray(getattr(new[n], "data", new[n]))
+                if is_quantized_leaf(resident):
+                    # quantized engine: re-quantize the incoming f32
+                    # checkpoint host-side, land beside the resident
+                    # shardings (payload + its per-channel scale)
+                    if tuple(host.shape) != tuple(resident["q"].shape):
+                        raise MXNetError(
+                            "update_params: %s %r shape %s does not match "
+                            "the compiled graph's %s — the AOT "
+                            "executables bind shapes; rebuild the engine "
+                            "for a different architecture"
+                            % (kind, n, tuple(host.shape),
+                               tuple(resident["q"].shape)))
+                    stored = quantize_array(
+                        np.asarray(host, np.float32), self.quant_mode)
+                    out[n] = {
+                        "q": jax.device_put(stored["q"],
+                                            resident["q"].sharding),
+                        "s": jax.device_put(stored["s"],
+                                            resident["s"].sharding)}
+                    continue
+                arr = jnp.asarray(host)
                 if tuple(arr.shape) != tuple(resident.shape):
                     raise MXNetError(
                         "update_params: %s %r shape %s does not match the "
@@ -478,7 +552,11 @@ class ServingEngine(object):
         # land the transfers BEFORE the rebind: a request dispatched the
         # instant after the swap must never block on (or race) an H2D
         for v in list(new_params.values()) + list(new_aux.values()):
-            v.block_until_ready()
+            if is_quantized_leaf(v):
+                v["q"].block_until_ready()
+                v["s"].block_until_ready()
+            else:
+                v.block_until_ready()
         # atomic rebind (CPython assignment): concurrent infer() sees the
         # old set or the new set, never a mix
         self._params, self._aux = new_params, new_aux
@@ -553,8 +631,10 @@ class ServingEngine(object):
                 "input_dtypes": {n: str(d)
                                  for n, d in self._input_dtypes.items()},
                 # a sharded executable only loads against the same mesh
-                # width; a mismatch falls back to fresh AOT compilation
-                "model_devices": self.model_devices}
+                # width, a quantized one only against the same weight
+                # storage; a mismatch falls back to fresh AOT compilation
+                "model_devices": self.model_devices,
+                "quantize": self.quant_mode}
 
     def export_compiled(self, path):
         """Serialize every bucket's compiled executable to ``path``
@@ -594,6 +674,30 @@ class ServingEngine(object):
                 "— falling back to fresh AOT compilation", path, e)
             self._compiled = {}
             return False
+
+    # ------------------------------------------------------------------
+    def weight_bytes(self):
+        """Resident HBM bytes of the engine's (possibly quantized)
+        parameter set — GLOBAL across model shards (a fully sharded
+        engine holds 1/N of this per chip). The memcheck-visible number
+        the int8 leg's >= 40% HBM-reduction gate is measured against
+        (docs/serving.md "Quantized weights")."""
+        from .quantize import tree_bytes
+        return tree_bytes(self._params) + tree_bytes(self._aux)
+
+    def quality_report(self, reference, probe_inputs):
+        """Quantization quality gate, step 1 (docs/serving.md "Quantized
+        weights"): run the SAME probe batch through this (quantized)
+        engine and an unquantized ``reference`` engine of the same graph,
+        and compare first-output argmax agreement + max logit drift. Feed
+        the result to :func:`mxnet_tpu.serving.quantize.check_quality`,
+        which raises below the ``MXTPU_SERVE_QUANT_MIN_AGREE`` floor —
+        ci/serve.sh runs exactly this before trusting a quantized
+        deploy."""
+        from .quantize import quality_report as _qr
+        ref = reference.infer(probe_inputs)[0]
+        got = self.infer(probe_inputs)[0]
+        return _qr(ref, got)
 
     # ------------------------------------------------------------------
     def memory_report(self, top=8):

@@ -672,6 +672,31 @@ class FleetRouter(object):
                 "replicas": self.replica_report(),
                 "join_errors": join_errors}
 
+    def weight_report(self):
+        """Resident weight bytes across the fleet's engines, AS STORED
+        (int8/bf16 after quantization, not f32 equivalents), plus the
+        per-chip share for model-axis-sharded engines — a quantized
+        N-way-sharded replica holds ``weight_bytes / N`` of the quantized
+        footprint on each chip (docs/serving.md "Quantized weights").
+        Replicas sharing one engine (warm rejoin) are counted once."""
+        out = {}
+        with self._lock:
+            reps = [r for r in self._replicas.values()
+                    if r.state not in (DEAD, RETIRED)]
+        seen = set()
+        for r in reps:
+            eng = r.batcher.engine
+            if id(eng) in seen:
+                continue
+            seen.add(id(eng))
+            total = int(eng.weight_bytes())
+            ndev = int(eng.model_devices)
+            out[eng.name] = {"weight_bytes": total,
+                             "model_devices": ndev,
+                             "bytes_per_chip": total // max(1, ndev),
+                             "quantize": eng.quant_mode}
+        return out
+
     def check(self, memory=False, comms=False):
         """Static-analyze every in-rotation replica's program set
         (tracecheck, plus the memory/comms lints) — the fleet CI gate

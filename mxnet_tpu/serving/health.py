@@ -32,6 +32,11 @@ class ServingHealth(object):
         self.requeued = 0          # requests moved off a dead/draining
         #                            replica back into the fleet queue
         #                            (NOT failed — the no-silent-shed path)
+        self.prefix_hits = 0       # joins that implanted a cached prefix
+        self.prefix_prefills = 0   # prefixes prefilled + stored for reuse
+        self.spec_rounds = 0       # draft-K-then-verify rounds dispatched
+        self.spec_drafted = 0      # draft proposals the target ruled on
+        self.spec_accepted = 0     # draft tokens the target verified
         self.last_error = None
 
     def _bump(self, field, n=1, err=None):
@@ -77,6 +82,20 @@ class ServingHealth(object):
     def record_requeued(self, n=1):
         self._bump("requeued", n=n)
 
+    def record_prefix_hit(self):
+        self._bump("prefix_hits")
+
+    def record_prefix_prefill(self):
+        self._bump("prefix_prefills")
+
+    def record_spec_round(self, drafted, accepted):
+        with self._lock:
+            self.spec_rounds += 1
+            self.spec_drafted += int(drafted)
+            self.spec_accepted += int(accepted)
+        if self._parent is not None:
+            self._parent.record_spec_round(drafted, accepted)
+
     def report(self):
         with self._lock:
             return {
@@ -86,6 +105,11 @@ class ServingHealth(object):
                 "shed": self.shed, "errors": self.errors,
                 "decode_steps": self.decode_steps, "joined": self.joined,
                 "retired": self.retired, "requeued": self.requeued,
+                "prefix_hits": self.prefix_hits,
+                "prefix_prefills": self.prefix_prefills,
+                "spec_rounds": self.spec_rounds,
+                "spec_drafted": self.spec_drafted,
+                "spec_accepted": self.spec_accepted,
                 "last_error": self.last_error,
             }
 
@@ -95,6 +119,8 @@ class ServingHealth(object):
             self.padded = self.expired = self.dropped = 0
             self.shed = self.errors = self.decode_steps = 0
             self.joined = self.retired = self.requeued = 0
+            self.prefix_hits = self.prefix_prefills = 0
+            self.spec_rounds = self.spec_drafted = self.spec_accepted = 0
             self.last_error = None
 
     def __repr__(self):

@@ -63,6 +63,8 @@ ALL_SITES = [
     ("kvstore.push", "transient"),
     ("serve.decode_die", "die"),
     ("serve.enqueue_drop", "drop"),
+    ("serve.sample", "raise"),
+    ("serve.spec_verify", "raise"),
     ("superbatch.producer", "die"),
 ]
 

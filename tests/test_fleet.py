@@ -571,7 +571,7 @@ def test_sharded_decode_greedy_token_parity():
         t2 = l2.generate([3, 5, 7], 8).result(timeout=30.0)
         assert t1 == t2
         shard_shapes = {tuple(s.data.shape)
-                        for s in l2._cache["k"].addressable_shards}
+                        for s in l2._state["k"].addressable_shards}
         assert shard_shapes == {(2, 2, 2, 24, 4)}   # heads 4 -> 2 per dev
         bad = [f for f in l2.check(memory=True, comms=True)
                if not f.suppressed]

@@ -137,7 +137,9 @@ BINARY = {
     "_mod": np.mod, "elemwise_mod": np.mod,
     "_power": np.power, "elemwise_power": np.power,
     "_maximum": np.maximum, "elemwise_maximum": np.maximum,
+    "maximum": np.maximum,
     "_minimum": np.minimum, "elemwise_minimum": np.minimum,
+    "minimum": np.minimum,
     "_hypot": np.hypot, "elemwise_hypot": np.hypot,
     "_equal": lambda a, b: (a == b).astype(np.float32),
     "elemwise_equal": lambda a, b: (a == b).astype(np.float32),
@@ -339,6 +341,9 @@ SAMPLE_OPS = {
 
 # ops proven in dedicated suites; this sweep must not double-maintain them
 COVERED_ELSEWHERE = {
+    "TransformerStack":
+        "test_lm_flagship/test_serving (models.transformer builds the "
+        "whole LM through it)",
     "Activation": "test_operator", "BatchNorm": "test_operator/test_pallas",
     "Convolution": "test_operator", "Deconvolution": "test_operator",
     "FullyConnected": "test_operator", "Pooling": "test_operator",

@@ -565,7 +565,11 @@ def test_decode_memory_report_cache_aliased():
                               num_heads=_LM["num_heads"],
                               max_len=_LM["seq_len"], slots=2)
     try:
-        (name, rep), = loop.memory_report().items()
+        # the program set now includes the prefix-cache get/put helpers;
+        # the decode body is the one named "step[...]"
+        reports = loop.memory_report()
+        (name, rep), = [(n, r) for n, r in reports.items()
+                        if "step[" in n]
         embed = params["tok_embed_weight"].shape[1]
         head_dim = embed // _LM["num_heads"]
         cache_bytes = 2 * (_LM["num_layers"] * 2 * _LM["num_heads"]
