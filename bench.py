@@ -166,14 +166,15 @@ from mxnet_tpu.base import env_float, env_int
 
 def _peak_flops(device):
     """Peak dense bf16 FLOP/s by TPU generation — ONE table, owned by
-    commscheck (its roofline and this bench's MFU must agree on the same
-    device). Unknown kinds return None here (MFU is omitted rather than
-    guessed) instead of commscheck's nominal CPU fallback."""
-    from mxnet_tpu.commscheck import PEAK_FLOPS_PER_S
-    kind = getattr(device, "device_kind", "")
-    for k, v in PEAK_FLOPS_PER_S.items():
-        if kind.startswith(k):
-            return v, kind
+    mxnet_tpu.devspec (commscheck's roofline, flopcheck's and this
+    bench's MFU must agree on the same device). Unknown kinds return
+    None here (MFU is omitted rather than guessed) instead of devspec's
+    nominal CPU fallback."""
+    from mxnet_tpu import devspec
+    spec, source = devspec.lookup(device)
+    kind = devspec.device_kind(device)
+    if source == "spec":
+        return spec.peak_flops_per_s, kind
     return None, kind
 
 
@@ -1294,6 +1295,15 @@ def lm_main():
     except Exception as exc:
         print("WARNING: commscheck analysis failed, no comms fields "
               "emitted: %r" % exc, file=sys.stderr)
+    roof = None
+    try:
+        from mxnet_tpu import flopcheck
+        if compiled1 is not None:
+            roof = flopcheck.analyze_compiled(
+                compiled1, "bench-lm-scan", loop_trips=k)
+    except Exception as exc:
+        print("WARNING: flopcheck analysis failed, no roofline fields "
+              "emitted: %r" % exc, file=sys.stderr)
 
     # per-mesh rows: SAME global batch, SAME harness; the sharded scan's
     # comms audit (commscheck.analyze compiles from the captured sharded
@@ -1328,12 +1338,12 @@ def lm_main():
     peak, kind = _peak_flops(jax.devices()[0])
     peak_source = "spec"
     if peak is None:
-        # CPU / unknown device: the commscheck roofline's documented
-        # nominal fallback, clearly labeled — an MFU against a guessed
-        # spec-sheet number would be misinformation, but the forced-host
-        # CI line still needs a deterministic utilization figure
-        from mxnet_tpu.commscheck import DEFAULT_PEAK_FLOPS_PER_S
-        peak, peak_source = DEFAULT_PEAK_FLOPS_PER_S, "nominal-fallback"
+        # CPU / unknown device: devspec's documented nominal fallback,
+        # clearly labeled — an MFU against a guessed spec-sheet number
+        # would be misinformation, but the forced-host CI line still
+        # needs a deterministic utilization figure
+        from mxnet_tpu.devspec import DEFAULT_SPEC
+        peak, peak_source = DEFAULT_SPEC.peak_flops_per_s, "nominal-fallback"
     out = {
         "metric": "lm_train_tokens_per_sec_b%d_s%d_%s_k%d"
                   % (batch, seq, cdtype, k),
@@ -1359,6 +1369,13 @@ def lm_main():
         out["predicted_efficiency"] = (
             None if comms.predicted_efficiency is None
             else round(comms.predicted_efficiency, 3))
+    if roof is not None and not roof.hlo_unavailable:
+        # the flopcheck roofline's forecast rides next to the measured
+        # number: a widening measured-vs-predicted MFU gap means either
+        # the wire model drifted or the schedule did
+        out["predicted_step_ms"] = round(roof.predicted_step_ms, 4)
+        if roof.predicted_mfu is not None:
+            out["predicted_mfu"] = round(roof.predicted_mfu, 6)
     if flops_per_sample:
         out["gflop_per_token_xla"] = round(flops_per_sample / seq / 1e9, 4)
         out["achieved_tflops"] = round(ips1 * flops_per_sample / 1e12, 4)
@@ -1580,6 +1597,22 @@ def main():
     except Exception as exc:
         print("WARNING: commscheck analysis failed, no comms fields "
               "emitted: %r" % exc, file=sys.stderr)
+    # static roofline forecast of the same executable (docs/
+    # static_analysis.md "Roofline lints"): predicted step time + MFU
+    # ride next to the measured img/s so the forecast-vs-measured gap is
+    # one JSON line — the third analyzer sharing measured_compiled's
+    # single compile
+    roof = None
+    try:
+        from mxnet_tpu import flopcheck
+        if measured_compiled is not None:
+            roof = flopcheck.analyze_compiled(
+                measured_compiled,
+                "bench-scan" if spd > 1 else "bench-step",
+                mesh=step.mesh, loop_trips=max(1, spd))
+    except Exception as exc:
+        print("WARNING: flopcheck analysis failed, no roofline fields "
+              "emitted: %r" % exc, file=sys.stderr)
 
     peak, kind = _peak_flops(jax.devices()[0])
     metric = "resnet%d_train_images_per_sec_b%d_%s" % (depth, batch, cdtype)
@@ -1609,6 +1642,10 @@ def main():
         out["predicted_efficiency"] = (
             None if comms.predicted_efficiency is None
             else round(comms.predicted_efficiency, 3))
+    if roof is not None and not roof.hlo_unavailable:
+        out["predicted_step_ms"] = round(roof.predicted_step_ms, 4)
+        if roof.predicted_mfu is not None:
+            out["predicted_mfu"] = round(roof.predicted_mfu, 6)
     if flops_per_img:
         out["gflop_per_image_xla"] = round(flops_per_img / 1e9, 2)
         out["achieved_tflops"] = round(ips * flops_per_img / 1e12, 1)

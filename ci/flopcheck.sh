@@ -1,0 +1,44 @@
+#!/bin/sh
+# CI gate: the COMBINED compile-once static audit (docs/static_analysis.md
+# "Roofline lints"). The flopcheck CLI compiles every zoo program plus the
+# PR 7 sharded gate set ONCE and feeds the same executables to all three
+# per-program analyzers:
+#
+#   flopcheck  — kernel inventory + roofline lints (memory-bound-hot /
+#                layout-copy / tiny-dispatch / predicted-mfu), drift gate
+#                vs FLOPCHECK_baseline.json (kernel count, predicted step
+#                ms, predicted MFU, top-hotspot identity; tolerance
+#                MXTPU_FLOPCHECK_TOL, default 10%)
+#   memcheck   — HBM lints + per-model resident sets, peak/temp bytes vs
+#                MEMCHECK_baseline.json (zoo programs)
+#   commscheck — collective inventory + comms lints, per-dispatch
+#                collective count/bytes vs COMMSCHECK_baseline.json
+#
+# This replaces three separate compile-everything sweeps (ci/memcheck.sh
+# and ci/commscheck.sh stay on disk for standalone runs and baseline
+# refreshes); the compile phase logs the wall-clock the sharing saved.
+#
+# Baseline-update workflow (docs/static_analysis.md):
+#   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+#     python -m mxnet_tpu.flopcheck --zoo --sharded \
+#     --write-baseline FLOPCHECK_baseline.json
+# and commit the diff alongside the change that moved the numbers.
+#
+# Usage: ci/flopcheck.sh [model,model,...]   (default: zoo + sharded set
+# gated against all three baselines; an explicit subset skips the
+# sharded set and the baselines)
+set -e
+cd "$(dirname "$0")/.."
+MODELS="$1"
+if [ -n "$MODELS" ]; then
+    set -- --models "$MODELS"
+else
+    set -- --zoo --sharded \
+        --baseline FLOPCHECK_baseline.json \
+        --memcheck-baseline MEMCHECK_baseline.json \
+        --commscheck-baseline COMMSCHECK_baseline.json
+fi
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+    PYTHONPATH=. python -m mxnet_tpu.flopcheck "$@"
+echo "flopcheck PASS"

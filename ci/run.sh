@@ -17,17 +17,14 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # static lints over the model zoo's compiled step programs
 # (docs/static_analysis.md; tier-1 keeps a faster 2-model smoke)
 ./ci/tracecheck.sh
-# static HBM audit + baseline regression gate over the same zoo
-# (docs/static_analysis.md "Memory lints"): peak/temp bytes per compiled
-# program vs the committed MEMCHECK_baseline.json, tolerance band
-# MXTPU_MEMCHECK_TOL
-./ci/memcheck.sh
-# static collective-communication audit + drift gate (docs/
-# static_analysis.md "Communication lints"): collective inventory +
-# comms lints over the zoo AND the PR 7 sharded set (dp lenet scan,
-# dp x tp resnet18, dp x sp ring transformer), per-dispatch collective
-# count/bytes vs the committed COMMSCHECK_baseline.json
-./ci/commscheck.sh
+# combined compile-once static audit (docs/static_analysis.md "Roofline
+# lints"): each zoo + sharded program compiles ONCE and the same
+# executable feeds all three per-program analyzers — flopcheck's kernel
+# inventory + roofline lints + drift gate vs FLOPCHECK_baseline.json,
+# memcheck's HBM lints + resident sets vs MEMCHECK_baseline.json, and
+# commscheck's collective inventory vs COMMSCHECK_baseline.json
+# (ci/memcheck.sh and ci/commscheck.sh stay for standalone runs)
+./ci/flopcheck.sh
 # zoo-dispatch gate (docs/perf.md "Packed accumulators"): every zoo
 # model must report a non-fallback K-step dispatch path (or a named,
 # documented reason) — precheck sweep over the whole zoo + real

@@ -37,8 +37,23 @@ __all__ = [
     "train_space", "serve_space", "decode_space", "space_for",
     "enabled", "tune", "resolve_train_knobs", "resolve_serve_knobs",
     "resolve_decode_knobs", "resolve_fit_knobs", "note_db_resolution",
+    "hotspot_report",
     "TRAIN_OBJECTIVES", "SERVE_OBJECTIVES", "DECODE_OBJECTIVES",
 ]
+
+
+def hotspot_report(fn, args=(), kwargs=None, name=None, mesh=None,
+                   loop_trips=1, top=10, memory_only=True):
+    """The Pallas tier's shopping list for ONE program: the flopcheck
+    roofline's ranked hotspot entries (docs/static_analysis.md
+    "Roofline lints") — exposed here because the hand-kernel search
+    starts where the measured-search driver stops: the memory-bound
+    kernels the compiler cannot fuse its way out of. Delegates to
+    :func:`mxnet_tpu.flopcheck.hotspot_report`."""
+    from .. import flopcheck
+    return flopcheck.hotspot_report(
+        fn, args, kwargs=kwargs, name=name, mesh=mesh,
+        loop_trips=loop_trips, top=top, memory_only=memory_only)
 
 TRAIN_OBJECTIVES = ("img_per_sec", "tokens_per_sec")
 SERVE_OBJECTIVES = ("serve_p99", "serve_p50")

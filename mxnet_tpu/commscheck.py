@@ -113,59 +113,24 @@ COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
 
 DEFAULT_LOOP_ALLOW = ("all-reduce", "collective-permute")
 
-#: approximate one-directional inter-chip link bandwidth per device kind
-#: (bytes/s; public ICI figures, order-of-magnitude — the roofline is a
-#: model and the multichip gate cross-checks it against measurement)
-LINK_BYTES_PER_S = {
-    "TPU v2": 6.2e10,
-    "TPU v3": 8.1e10,
-    "TPU v4": 1.2e11,
-    "TPU v5 lite": 4.5e10,
-    "TPU v5e": 4.5e10,
-    "TPU v5p": 9.0e10,
-    "TPU v6 lite": 9.0e10,
-    "TPU v6e": 9.0e10,
-}
+# the per-device-kind capability rows live in ONE shared table
+# (mxnet_tpu.devspec) consumed by this roofline, bench MFU and
+# flopcheck; these module-level names are kept as backward-compatible
+# views (bench importing PEAK_FLOPS_PER_S from here keeps working)
+from .devspec import (DEVICE_SPECS, DEFAULT_SPEC,
+                      link_bandwidth, peak_flops)
+
+#: one-directional inter-chip link bandwidth per device kind (bytes/s) —
+#: a VIEW of :data:`mxnet_tpu.devspec.DEVICE_SPECS`
+LINK_BYTES_PER_S = {k: s.link_bytes_per_s for k, s in DEVICE_SPECS.items()}
 #: CPU / unknown backends: a nominal shared-memory "link" so predictions
 #: stay finite and deterministic on the forced-host CI mesh
-DEFAULT_LINK_BYTES_PER_S = 1.0e10
+DEFAULT_LINK_BYTES_PER_S = DEFAULT_SPEC.link_bytes_per_s
 
-#: peak dense FLOP/s per device kind (bf16 spec-sheet numbers, the same
-#: table bench.py's MFU uses); CPU fallback is a nominal few-core figure
-PEAK_FLOPS_PER_S = {
-    "TPU v2": 46e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-DEFAULT_PEAK_FLOPS_PER_S = 5.0e10
-
-
-def link_bandwidth(device=None):
-    """Predicted link bandwidth (bytes/s) for the roofline, by device
-    kind; the documented CPU/unknown fallback otherwise."""
-    import jax
-    device = device or jax.devices()[0]
-    kind = getattr(device, "device_kind", "")
-    for k, v in LINK_BYTES_PER_S.items():
-        if kind.startswith(k):
-            return v
-    return DEFAULT_LINK_BYTES_PER_S
-
-
-def peak_flops(device=None):
-    """Predicted peak FLOP/s for the roofline, by device kind."""
-    import jax
-    device = device or jax.devices()[0]
-    kind = getattr(device, "device_kind", "")
-    for k, v in PEAK_FLOPS_PER_S.items():
-        if kind.startswith(k):
-            return v
-    return DEFAULT_PEAK_FLOPS_PER_S
+#: peak dense bf16 FLOP/s per device kind — the same devspec rows
+#: bench.py's MFU and flopcheck's roofline use
+PEAK_FLOPS_PER_S = {k: s.peak_flops_per_s for k, s in DEVICE_SPECS.items()}
+DEFAULT_PEAK_FLOPS_PER_S = DEFAULT_SPEC.peak_flops_per_s
 
 
 def repl_bytes():

@@ -247,6 +247,35 @@ def set_commscheck(mode):
     return prev
 
 
+_flopcheck_override = None
+
+
+def flopcheck_mode():
+    """Compute/memory roofline audit policy for dispatch programs
+    (docs/static_analysis.md "Roofline lints"): ``"off"`` (default)
+    skips the audit — the CLI/CI drift gate covers the committed program
+    sets; ``"warn"`` makes ``TrainStep`` run the roofline lints ONCE per
+    compiled program at its first dispatch (one extra compile, arguments
+    reduced to structs) and log unsuppressed findings; ``"error"``
+    raises :class:`~mxnet_tpu.base.MXNetError` — a fusion regression
+    that shatters the step into tiny dispatches fails at the first
+    dispatch, not after a slow profiling session. Env default:
+    ``MXTPU_FLOPCHECK``."""
+    if _flopcheck_override is not None:
+        return _flopcheck_override
+    return _mode_from_env("MXTPU_FLOPCHECK", "off")
+
+
+def set_flopcheck(mode):
+    """Override the flopcheck mode (None = back to the env/default);
+    returns the previous effective value."""
+    global _flopcheck_override
+    prev = flopcheck_mode()
+    _validate_mode(mode, "set_flopcheck")
+    _flopcheck_override = mode
+    return prev
+
+
 def maybe_sync(arr):
     """Called after each imperative op; blocks in naive mode."""
     if _naive and arr is not None:

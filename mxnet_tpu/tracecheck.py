@@ -114,6 +114,13 @@ MEM_LINTS = ("hbm-budget", "donation-waste", "temp-blowup", "resident-set")
 COMM_LINTS = ("resharding-copy", "replicated-large", "gather-in-loop",
               "comms-bound")
 
+#: roofline lints (implemented in :mod:`mxnet_tpu.flopcheck` — the
+#: compute/memory-bandwidth side, the fourth and final leg of the
+#: static-analysis suite; docs/static_analysis.md "Roofline lints").
+#: Declared here so ONE suppression registry covers all four analyzers.
+ROOFLINE_LINTS = ("memory-bound-hot", "layout-copy", "tiny-dispatch",
+                  "predicted-mfu")
+
 #: gather-type collective primitives that must NOT appear inside a scan
 #: body (jaxpr level — explicit shard_map collectives). ``psum`` is the
 #: expected grad/metric sync and ``ppermute`` the ring/pipeline schedule
@@ -210,10 +217,12 @@ def add_suppression(lint, program=None):
     """Suppress ``lint`` findings globally, or only for programs whose name
     contains ``program``. Returns a token usable with
     :func:`remove_suppression`."""
-    if lint not in LINTS + MEM_LINTS + COMM_LINTS and lint != "*":
+    if (lint not in LINTS + MEM_LINTS + COMM_LINTS + ROOFLINE_LINTS
+            and lint != "*"):
         raise MXNetError("tracecheck: unknown lint %r (have %s)"
                          % (lint, ", ".join(LINTS + MEM_LINTS
-                                            + COMM_LINTS)))
+                                            + COMM_LINTS
+                                            + ROOFLINE_LINTS)))
     tok = (lint, program)
     _SUPPRESSIONS.add(tok)
     return tok

@@ -1052,6 +1052,16 @@ class TrainStep(object):
                          else 1)
                 _cc.maybe_audit_dispatch(name, jitfn, call_args,
                                          loop_trips=trips, mesh=self.mesh)
+            # MXTPU_FLOPCHECK (docs/static_analysis.md "Roofline
+            # lints"): one-time roofline audit of every freshly compiled
+            # program (single-device too — a fusion regression needs no
+            # mesh to hurt); same struct-args discipline as above.
+            from . import flopcheck as _fc
+            _fc.maybe_audit_dispatch(
+                name, jitfn, call_args,
+                loop_trips=(cache_key[1] if isinstance(cache_key, tuple)
+                            else 1),
+                mesh=self.mesh)
         try:
             self._watcher.after_call(key, jitfn, _tc.signature(call_args),
                                      health=self.health)
